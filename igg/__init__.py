@@ -1,0 +1,86 @@
+"""igg — Implicit Global Grid, TPU-native.
+
+A brand-new JAX/XLA framework with the capabilities of
+`ImplicitGlobalGrid.jl` (reference at `/root/reference`): distributed
+stencil-based simulations on regular staggered grids, where a solver written
+for a single device's local `(nx, ny, nz)` array runs unchanged on an
+implicitly-assembled global grid over a whole TPU slice.
+
+Instead of a Cartesian MPI topology with CUDA-aware point-to-point halo
+messages, the grid is a `jax.sharding.Mesh` whose axes are the grid
+dimensions; halo updates are XLA collective-permutes over ICI fused with their
+pack/unpack slices, and whole time steps compile to single SPMD programs whose
+communication XLA overlaps with interior compute.
+
+Public API (reference: the 13 exported symbols at
+`/root/reference/src/ImplicitGlobalGrid.jl:10-22`):
+
+    init_global_grid, finalize_global_grid, update_halo, gather,
+    select_device, nx_g, ny_g, nz_g, x_g, y_g, z_g, tic, toc
+
+plus TPU-native extensions: field constructors (`zeros`, `ones`, `full`),
+coordinate fields (`x_g_field`, ..., `coord_fields`), whole-step SPMD
+compilation (`sharded`, `update_halo_local`, `local_coords`), and
+`gather_interior`.
+"""
+
+from .shared import (
+    AXIS_NAMES,
+    NDIMS,
+    NNEIGHBORS_PER_DIM,
+    PROC_NULL,
+    GlobalGrid,
+    GridError,
+    get_global_grid,
+    grid_is_initialized,
+)
+from .init import init_global_grid
+from .finalize import finalize_global_grid
+from .halo import update_halo, update_halo_local
+from .gather import gather, gather_interior
+from .device import select_device
+from .tools import (
+    barrier,
+    coord_fields,
+    nx_g,
+    ny_g,
+    nz_g,
+    tic,
+    toc,
+    x_g,
+    x_g_field,
+    y_g,
+    y_g_field,
+    z_g,
+    z_g_field,
+)
+from .fields import (
+    from_local_blocks,
+    full,
+    local_block,
+    local_blocks,
+    ones,
+    spec_for,
+    sharding_for,
+    stacked_shape,
+    zeros,
+)
+from .parallel import local_coords, sharded
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AXIS_NAMES", "NDIMS", "NNEIGHBORS_PER_DIM", "PROC_NULL",
+    "GlobalGrid", "GridError", "get_global_grid", "grid_is_initialized",
+    "init_global_grid", "finalize_global_grid",
+    "update_halo", "update_halo_local",
+    "gather", "gather_interior",
+    "select_device",
+    "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g",
+    "x_g_field", "y_g_field", "z_g_field", "coord_fields",
+    "tic", "toc", "barrier",
+    "zeros", "ones", "full", "from_local_blocks", "local_blocks",
+    "local_block", "spec_for", "sharding_for", "stacked_shape",
+    "local_coords", "sharded",
+    "__version__",
+]

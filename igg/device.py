@@ -1,0 +1,28 @@
+"""Device selection.
+
+Counterpart of `/root/reference/src/select_device.jl`.  The reference maps the
+node-local MPI rank onto a CUDA device (`CUDA.device!(me_l)`); under JAX the
+runtime already binds each process to its local TPU chips and the mesh handles
+placement, so this is a thin parity shim that validates devices exist and
+returns the id of this process's primary device.
+"""
+
+from __future__ import annotations
+
+from .shared import GridError, check_initialized
+
+
+def select_device() -> int:
+    """Return the id of the device this process primarily drives.
+
+    Raises if no accelerator (or CPU fallback) device is available, mirroring
+    the reference's error when CUDA is not functional
+    (`/root/reference/src/select_device.jl:18`).
+    """
+    import jax
+
+    check_initialized()
+    devices = jax.local_devices()
+    if not devices:
+        raise GridError("Cannot select a device: no JAX devices are available.")
+    return devices[0].id

@@ -1,0 +1,112 @@
+"""Field (array) creation on the implicit global grid.
+
+The reference never owns the user's arrays — users allocate local
+`(nx, ny, nz)` arrays themselves (`/root/reference/src/shared.jl:32`,
+`GGArray = Union{Array, CuArray}`).  On TPU under a single controller the
+idiomatic equivalent is a *block-stacked global* `jax.Array`: shape
+`dims .* local_shape`, sharded over the mesh axes so each device holds exactly
+one reference-style local array (halo cells included).  Staggered arrays
+(`nx+1` etc., cf. `/root/reference/src/tools.jl:49`) stack/shard evenly by
+construction, so no uneven-sharding problems arise.
+
+The stacked layout is identical to the tiling `gather!` produces in the
+reference (`/root/reference/src/gather.jl:63-66`): block (cx,cy,cz) of the
+stacked array is the local array of the device at those grid coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import shared
+from .shared import AXIS_NAMES, NDIMS
+
+
+def spec_for(ndim: int):
+    """PartitionSpec sharding array dims 0..2 over the grid axes x, y, z."""
+    from jax.sharding import PartitionSpec as P
+    return P(*AXIS_NAMES[:min(ndim, NDIMS)])
+
+
+def sharding_for(ndim: int, grid: Optional[shared.GlobalGrid] = None):
+    from jax.sharding import NamedSharding
+    grid = grid or shared.global_grid()
+    return NamedSharding(grid.mesh, spec_for(ndim))
+
+
+def stacked_shape(local_shape: Sequence[int],
+                  grid: Optional[shared.GlobalGrid] = None) -> Tuple[int, ...]:
+    """Global (stacked) shape for a per-device `local_shape`."""
+    grid = grid or shared.global_grid()
+    return tuple(
+        int(s) * (grid.dims[d] if d < NDIMS else 1)
+        for d, s in enumerate(local_shape))
+
+
+def zeros(local_shape: Sequence[int], dtype=None):
+    """A grid array where every device holds a `local_shape` block of zeros
+    (the counterpart of `zeros(nx, ny, nz)` / `CUDA.zeros` in the reference
+    examples, `/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:26`)."""
+    import jax.numpy as jnp
+    shared.check_initialized()
+    return jnp.zeros(stacked_shape(local_shape), dtype or jnp.float32,
+                     device=sharding_for(len(local_shape)))
+
+
+def ones(local_shape: Sequence[int], dtype=None):
+    import jax.numpy as jnp
+    shared.check_initialized()
+    return jnp.ones(stacked_shape(local_shape), dtype or jnp.float32,
+                    device=sharding_for(len(local_shape)))
+
+
+def full(local_shape: Sequence[int], fill_value, dtype=None):
+    import jax.numpy as jnp
+    shared.check_initialized()
+    return jnp.full(stacked_shape(local_shape), fill_value, dtype or jnp.float32,
+                    device=sharding_for(len(local_shape)))
+
+
+def from_local_blocks(fn: Callable, local_shape: Sequence[int], dtype=None):
+    """Assemble a grid array from per-coordinate local blocks.
+
+    ``fn(coords, local_shape) -> np.ndarray`` is evaluated for every grid
+    coordinate; the blocks are stacked and sharded onto the mesh.  This is the
+    test/initialization idiom of the reference, where every rank fills its
+    local array from its Cartesian coordinates
+    (`/root/reference/test/test_update_halo.jl:654`).
+    """
+    import jax
+    shared.check_initialized()
+    grid = shared.global_grid()
+    nd = len(local_shape)
+    dims = [grid.dims[d] if d < NDIMS else 1 for d in range(nd)]
+    out = np.empty(stacked_shape(local_shape), dtype=dtype or np.float32)
+    for cz in range(dims[2] if nd > 2 else 1):
+        for cy in range(dims[1] if nd > 1 else 1):
+            for cx in range(dims[0]):
+                coords = (cx, cy, cz)[:max(nd, 1)]
+                block = np.asarray(fn(coords + (0,) * (3 - len(coords)), tuple(local_shape)))
+                sl = tuple(slice(c * s, (c + 1) * s)
+                           for c, s in zip((cx, cy, cz)[:nd], local_shape))
+                out[sl] = block
+    return jax.device_put(out, sharding_for(nd))
+
+
+def local_blocks(A) -> np.ndarray:
+    """Fetch a grid array to host and return it as an np.ndarray indexable by
+    block: `local_blocks(A)[cx*s0:(cx+1)*s0, ...]` is the local array of the
+    device at coords (cx, cy, cz).  (Host-side test/visualization helper.)"""
+    import jax
+    return np.asarray(jax.device_get(A))
+
+
+def local_block(A, coords) -> np.ndarray:
+    """The local array of the device at grid `coords` (host copy)."""
+    grid = shared.global_grid()
+    s = grid.local_shape(A)
+    sl = tuple(slice(int(coords[d]) * s[d], (int(coords[d]) + 1) * s[d])
+               for d in range(A.ndim))
+    return local_blocks(A)[sl]

@@ -1,0 +1,37 @@
+"""Grid finalization.
+
+Counterpart of `/root/reference/src/finalize_global_grid.jl:18-30`: frees the
+gather buffer and the halo engine's (here: compiled-program) caches, optionally
+shuts down the distributed runtime, and resets the module singleton.
+"""
+
+from __future__ import annotations
+
+import gc
+
+from . import shared
+
+
+def finalize_global_grid(*, shutdown_distributed: bool = False) -> None:
+    """Finalize the global grid (and optionally `jax.distributed`).
+
+    `shutdown_distributed` is the analog of the reference's
+    `finalize_MPI=true`; it defaults to off because the JAX distributed
+    runtime is typically process-global and reusable.
+    """
+    shared.check_initialized()
+    grid = shared.global_grid()
+
+    from .halo import free_update_halo_buffers
+    from .gather import free_gather_buffer
+    from .parallel import free_sharded_cache
+    free_update_halo_buffers()
+    free_gather_buffer()
+    free_sharded_cache()
+
+    if shutdown_distributed and grid.distributed:
+        import jax
+        jax.distributed.shutdown()
+
+    shared.set_global_grid(None)
+    gc.collect()

@@ -1,0 +1,115 @@
+"""Gather local arrays into one global host array (visualization path).
+
+Counterpart of `/root/reference/src/gather.jl`.  The reference hand-rolls
+point-to-point receives into a persistent root buffer and re-tiles blocks in
+Cartesian order.  Here the block-stacked global array *already is* that
+Cartesian tiling (block (cx,cy,cz) of the stacked array == the local array of
+the device at those coords, the exact layout `cart_gather!` produces at
+`/root/reference/src/gather.jl:63-66`), so gather is a device→host transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from . import shared
+from .shared import GridError, NDIMS
+
+
+def free_gather_buffer() -> None:
+    """Parity shim (`/root/reference/src/gather.jl:22-26`): no persistent
+    host buffer is kept — the runtime manages transfer staging."""
+
+
+def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
+    """Gather the grid array `A` into one large host array on the root
+    process; returns `None` on non-root processes
+    (`/root/reference/src/gather.jl:28-32`).
+
+    The result has shape `dims .* local_shape(A)` — whole local blocks tiled
+    in Cartesian order, halos included, exactly like the reference (whose
+    examples strip overlaps before gathering,
+    `/root/reference/docs/examples/diffusion3D_multigpu_CuArrays.jl:53`; see
+    :func:`gather_interior` for the de-duplicated variant).
+
+    If `A_global` is given, the result is written into it (and `None` is
+    returned), after validating `A_global.size == nprocs * local_size` like
+    the reference (`/root/reference/src/gather.jl:41-42`).
+    """
+    shared.check_initialized()
+    grid = shared.global_grid()
+
+    if grid.me != root:
+        if A_global is not None:
+            raise GridError("The input argument A_global must be None (or "
+                            "omitted) on non-root processes.")
+        _fetch_global(A)  # non-root controllers still participate
+        return None
+
+    local = grid.local_shape(A)
+    out = _fetch_global(A)
+
+    if A_global is None:
+        return out
+    nlocal = int(np.prod(local))
+    if A_global.size != _nprocs_in(grid, A.ndim) * nlocal:
+        raise GridError("The input argument A_global must be of length "
+                        "nprocs*length(A)")
+    A_global[...] = out.reshape(A_global.shape)
+    return None
+
+
+def _fetch_global(A) -> np.ndarray:
+    """Device→host fetch of a (possibly multi-host) grid array.  On a
+    multi-host mesh, shards on non-addressable devices are exchanged over the
+    runtime first (the role MPI point-to-point plays in the reference's
+    `cart_gather!`, `/root/reference/src/gather.jl:52-58`)."""
+    import jax
+
+    if getattr(A, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(A))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(A, tiled=True))
+
+
+def gather_interior(A, *, root: int = 0):
+    """Gather with overlap de-duplication: returns the true global field of
+    shape `(nx_g(A), ny_g(A), nz_g(A))` (what reference users assemble by
+    hand after stripping halos).  Block `c` contributes its cells
+    `[0, s - ol)`; the last block of a non-periodic dimension also keeps its
+    trailing `ol` cells."""
+    shared.check_initialized()
+    grid = shared.global_grid()
+    if grid.me != root:
+        _fetch_global(A)
+        return None
+
+    stacked = _fetch_global(A)
+    local = grid.local_shape(A)
+    out = stacked
+    for d in range(min(A.ndim, NDIMS)):
+        n = grid.dims[d]
+        s = local[d]
+        ol = grid.ol_of_local(d, local)
+        keep = s - max(ol, 0)
+        pieces = []
+        for c in range(n):
+            block = np.take(out, range(c * s, (c + 1) * s), axis=d)
+            last = (c == n - 1)
+            if last and not grid.periods[d]:
+                pieces.append(block)
+            else:
+                pieces.append(np.take(block, range(keep), axis=d))
+        out = np.concatenate(pieces, axis=d) if len(pieces) > 1 else pieces[0]
+    return out
+
+
+def _nprocs_in(grid, ndim: int) -> int:
+    """Number of devices an array of rank `ndim` is distributed over (arrays
+    of lower rank than the grid only span the matching mesh axes)."""
+    n = 1
+    for d in range(min(ndim, NDIMS)):
+        n *= grid.dims[d]
+    return n

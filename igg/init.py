@@ -1,0 +1,135 @@
+"""Grid initialization.
+
+TPU-native counterpart of `init_global_grid`
+(`/root/reference/src/init_global_grid.jl:42-88`).  Instead of initializing
+MPI and creating a Cartesian communicator of processes, it creates a
+:class:`jax.sharding.Mesh` of TPU devices whose axes are the grid dimensions;
+`reorder=1` maps mesh axes onto the physical ICI torus.  Argument names,
+validation rules and the return tuple mirror the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import shared
+from .shared import GlobalGrid, GridError, NDIMS
+from .topology import create_mesh, dims_create
+
+
+def init_global_grid(nx: int, ny: int, nz: int, *,
+                     dimx: int = 0, dimy: int = 0, dimz: int = 0,
+                     periodx: int = 0, periody: int = 0, periodz: int = 0,
+                     overlapx: int = 2, overlapy: int = 2, overlapz: int = 2,
+                     disp: int = 1, reorder: int = 1,
+                     devices: Optional[Sequence] = None,
+                     init_distributed: bool = False,
+                     select_device: bool = True,
+                     quiet: bool = False):
+    """Initialize a Cartesian grid of devices defining implicitly a global grid.
+
+    Arguments mirror the reference (`/root/reference/src/init_global_grid.jl:42`):
+
+    - ``nx, ny, nz``: number of elements of the *local* (per-device) grid.
+    - ``dimx/y/z``: desired number of devices per dimension (0 = auto, chosen
+      as balanced as possible, like ``MPI_Dims_create``).
+    - ``periodx/y/z``: periodicity per dimension (0/1).
+    - ``overlapx/y/z``: cells adjacent local grids overlap (default 2).
+    - ``disp``/``reorder``: neighbor displacement / allow topology-aware device
+      placement (ICI-torus alignment), the analogs of the ``MPI.Cart_shift`` /
+      ``MPI.Cart_create`` arguments.
+    - ``devices``: devices to build the grid from (default: ``jax.devices()``,
+      i.e. every addressable device — the analog of ``MPI.COMM_WORLD``).
+    - ``init_distributed``: initialize ``jax.distributed`` for multi-host runs
+      (the analog of ``init_MPI=true``; default off because single-controller
+      JAX needs no process bootstrap on one host).
+    - ``select_device``: kept for API parity; device placement on TPU is
+      handled by the mesh, cf. :func:`igg.select_device`.
+
+    Returns ``(me, dims, nprocs, coords, mesh)`` like the reference returns
+    ``(me, dims, nprocs, coords, comm_cart)``
+    (`/root/reference/src/init_global_grid.jl:87`); the mesh plays the role of
+    the Cartesian communicator.
+    """
+    import jax
+
+    if shared.grid_is_initialized():
+        raise GridError("The global grid has already been initialized.")
+
+    nxyz = np.array([nx, ny, nz], dtype=int)
+    dims = np.array([dimx, dimy, dimz], dtype=int)
+    periods = np.array([periodx, periody, periodz], dtype=int)
+    overlaps = np.array([overlapx, overlapy, overlapz], dtype=int)
+
+    # Argument validation (reference `/root/reference/src/init_global_grid.jl:62-66`).
+    if nx == 1:
+        raise GridError("Invalid arguments: nx can never be 1.")
+    if ny == 1 and nz > 1:
+        raise GridError("Invalid arguments: ny cannot be 1 if nz is greater than 1.")
+    if np.any((nxyz == 1) & (dims > 1)):
+        raise GridError(
+            "Incoherent arguments: if nx, ny, or nz is 1, then the "
+            "corresponding dimx, dimy or dimz must not be set (or set 0 or 1).")
+    if np.any((nxyz < 2 * overlaps - 1) & (periods > 0)):
+        raise GridError(
+            "Incoherent arguments: if nx, ny, or nz is smaller than "
+            "2*overlapx-1, 2*overlapy-1 or 2*overlapz-1, respectively, then "
+            "the corresponding periodx, periody or periodz must not be set "
+            "(or set 0).")
+    # A dimension of size 1 forces a single device along it
+    # (`/root/reference/src/init_global_grid.jl:66`).
+    dims[(nxyz == 1) & (dims == 0)] = 1
+
+    if init_distributed:
+        jax.distributed.initialize()
+
+    if devices is None:
+        devices = jax.devices()
+    nprocs_avail = len(devices)
+    if np.all(dims > 0):
+        nprocs = int(np.prod(dims))
+    else:
+        nprocs = nprocs_avail
+    dims = np.array(dims_create(nprocs, dims), dtype=int)
+
+    mesh = create_mesh(tuple(dims), devices=devices, reorder=reorder)
+
+    # Global grid size (`/root/reference/src/init_global_grid.jl:82`):
+    # a periodic dimension has no outer boundary cells.
+    nxyz_g = dims * (nxyz - overlaps) + overlaps * (periods == 0)
+
+    me = int(jax.process_index())
+    # Coordinates of this controller process in the grid.  Single-controller
+    # (one process drives all devices): (0,0,0).  Per-device coordinates live
+    # on the mesh and are queried with `igg.local_coords()` inside SPMD code.
+    coords = (0, 0, 0)
+
+    gg = GlobalGrid(
+        nxyz_g=tuple(int(v) for v in nxyz_g),
+        nxyz=(int(nx), int(ny), int(nz)),
+        dims=tuple(int(v) for v in dims),
+        overlaps=tuple(int(v) for v in overlaps),
+        nprocs=int(nprocs),
+        me=me,
+        coords=coords,
+        periods=tuple(int(v) for v in periods),
+        disp=int(disp),
+        reorder=int(reorder),
+        mesh=mesh,
+        quiet=bool(quiet),
+        distributed=bool(init_distributed),
+    )
+    shared.set_global_grid(gg)
+
+    if not quiet and me == 0:
+        print(f"Global grid: {nxyz_g[0]}x{nxyz_g[1]}x{nxyz_g[2]} "
+              f"(nprocs: {nprocs}, dims: {dims[0]}x{dims[1]}x{dims[2]})")
+
+    # Warm up the timing functions (`/root/reference/src/init_global_grid.jl:86,91-94`).
+    from .tools import tic, toc
+    tic()
+    toc()
+
+    return me, tuple(int(v) for v in dims), int(nprocs), coords, mesh

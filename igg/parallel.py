@@ -1,0 +1,127 @@
+"""Whole-step SPMD execution over the grid mesh.
+
+The reference delegates comm/compute overlap to the caller (max-priority
+streams + `@hide_communication` in ParallelStencil,
+`/root/reference/README.md:9`).  The TPU-native equivalent is structural: the
+user writes their *entire* time step over reference-style local arrays and
+:func:`sharded` compiles it into ONE XLA program over the mesh — XLA's
+latency-hiding scheduler then overlaps the `ppermute` halo collectives with
+the interior compute automatically.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import shared
+from .fields import spec_for
+from .shared import AXIS_NAMES, NDIMS
+
+
+def local_coords() -> Tuple:
+    """(cx, cy, cz) grid coordinates of the executing device — only valid
+    inside SPMD code (functions wrapped with :func:`sharded`).  The per-device
+    analog of the reference's `coords` return value
+    (`/root/reference/src/init_global_grid.jl:77`)."""
+    from jax import lax
+    return tuple(lax.axis_index(a) for a in AXIS_NAMES)
+
+
+def _is_grid_leaf(x, grid) -> bool:
+    """Whether a pytree leaf is a grid array (shardable over the mesh):
+    every one of its leading <=3 dims is divisible by the mesh dims."""
+    shape = getattr(x, "shape", None)
+    if not shape:
+        return False
+    return all(shape[d] % grid.dims[d] == 0 and shape[d] >= grid.dims[d]
+               for d in range(min(len(shape), NDIMS)))
+
+
+def _leaf_spec(x, grid):
+    from jax.sharding import PartitionSpec as P
+    if _is_grid_leaf(x, grid):
+        return spec_for(len(x.shape))
+    return P()
+
+
+def _local_aval(x, grid):
+    import jax
+    import jax.numpy as jnp
+    if _is_grid_leaf(x, grid):
+        shape = tuple(
+            s // (grid.dims[d] if d < NDIMS else 1)
+            for d, s in enumerate(x.shape))
+        return jax.ShapeDtypeStruct(shape, x.dtype)
+    arr = jnp.asarray(x) if not hasattr(x, "dtype") else x
+    return jax.ShapeDtypeStruct(getattr(arr, "shape", ()), arr.dtype)
+
+
+_compiled: Dict[tuple, object] = {}
+
+
+def free_sharded_cache() -> None:
+    _compiled.clear()
+
+
+def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
+            out_specs=None):
+    """Compile `fn`, written over per-device *local* arrays (the reference's
+    programming model: the user's solver sees `(nx, ny, nz)` arrays,
+    `/root/reference/docs/examples/diffusion3D_multicpu_novis.jl:41-48`), into
+    a jitted `shard_map` program over the grid mesh operating on stacked
+    global arrays.
+
+    Inside `fn`, use :func:`igg.update_halo_local` for halo exchange and
+    :func:`local_coords` for the device's grid coordinates.  Array arguments
+    whose dims are divisible by the mesh are sharded over (gx, gy, gz) by
+    rank; scalars and non-divisible arrays are replicated.  Output specs are
+    inferred by rank via `jax.eval_shape` (override with `out_specs`).
+
+    `donate_argnums` donates those inputs to XLA so updates are in-place in
+    device HBM (use for the fields that the step returns updated).
+    """
+    def deco(f):
+        @wraps(f)
+        def wrapper(*args):
+            import jax
+
+            shared.check_initialized()
+            grid = shared.global_grid()
+            leaves, treedef = jax.tree.flatten(args)
+            key = (shared.grid_epoch(), f, treedef,
+                   tuple(donate_argnums), repr(out_specs),
+                   tuple((getattr(x, "shape", ()),
+                          str(getattr(x, "dtype", type(x)))) for x in leaves))
+            jfn = _compiled.get(key)
+            if jfn is None:
+                from jax.sharding import PartitionSpec as P
+
+                in_specs = jax.tree.map(lambda x: _leaf_spec(x, grid), args)
+                if out_specs is None:
+                    # Infer the output structure by abstract tracing with the
+                    # mesh axes bound (so collectives/axis_index trace), then
+                    # assign specs by rank.
+                    local_avals = jax.tree.map(lambda x: _local_aval(x, grid), args)
+                    axis_env = [(a, grid.dims[d])
+                                for d, a in enumerate(AXIS_NAMES)]
+                    _, out_aval = jax.make_jaxpr(
+                        f, axis_env=axis_env, return_shape=True)(*local_avals)
+                    o_specs = jax.tree.map(
+                        lambda a: spec_for(len(a.shape)) if a.shape else P(),
+                        out_aval)
+                else:
+                    o_specs = out_specs
+                sm = jax.shard_map(f, mesh=grid.mesh,
+                                   in_specs=tuple(in_specs), out_specs=o_specs)
+                jfn = jax.jit(sm, donate_argnums=tuple(donate_argnums))
+                _compiled[key] = jfn
+            out = jfn(*args)
+            if grid.needs_cpu_sync:
+                jax.block_until_ready(out)
+            return out
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

@@ -1,0 +1,247 @@
+"""Shared grid state, constants and accessors.
+
+TPU-native re-design of the reference's module-global grid state
+(`/root/reference/src/shared.jl:22-92`).  Where the reference keeps a mutable
+module singleton holding an `MPI.Comm`, we keep an immutable :class:`GlobalGrid`
+dataclass holding a :class:`jax.sharding.Mesh` — the mesh *is* the Cartesian
+communicator on TPU: its axes are the grid dimensions and XLA collectives
+(`ppermute`) over it replace MPI point-to-point messages.
+
+A module-level handle (`_global_grid`) is kept for API parity with the
+reference's five-verb, implicitly-stateful interface
+(`/root/reference/src/shared.jl:57-68`), but every piece of information is also
+reachable functionally through the returned/gettable :class:`GlobalGrid`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Number of grid dimensions handled internally; fixed to 3 like the reference
+# (`/root/reference/src/shared.jl:22`) so coords/dims/neighbors have a fixed,
+# simple layout.  1-D/2-D problems use trailing dims of size 1.
+NDIMS = 3
+
+# Left + right neighbor per dimension (`/root/reference/src/shared.jl:23`).
+NNEIGHBORS_PER_DIM = 2
+
+# Sentinel for "no neighbor" (open boundary at the edge of the process grid);
+# plays the role of MPI_PROC_NULL in the reference's neighbor table
+# (`/root/reference/src/init_global_grid.jl:78`).
+PROC_NULL = -1
+
+# Names of the mesh axes of the Cartesian device grid.  All sharded arrays are
+# partitioned over these axes by array dimension (x, y, z).
+AXIS_NAMES: Tuple[str, str, str] = ("gx", "gy", "gz")
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalGrid:
+    """Immutable description of the implicit global grid.
+
+    Counterpart of the reference's `GlobalGrid` struct
+    (`/root/reference/src/shared.jl:36-52`); the MPI communicator is replaced
+    by a JAX device mesh and per-rank fields (`me`, `coords`, `neighbors`) are
+    derivable for *any* grid coordinate (single-controller SPMD: one Python
+    process drives all devices, so there is no single ambient rank).
+    """
+
+    nxyz_g: Tuple[int, int, int]      # global grid size
+    nxyz: Tuple[int, int, int]        # local (per-device) grid size
+    dims: Tuple[int, int, int]        # devices per dimension
+    overlaps: Tuple[int, int, int]    # overlap cells per dimension
+    nprocs: int                       # total number of devices in the grid
+    me: int                           # rank of this controller process
+    coords: Tuple[int, int, int]      # cartesian coords of this process
+    periods: Tuple[int, int, int]     # periodicity per dimension (0/1)
+    disp: int                         # neighbor displacement (parity; always 1)
+    reorder: int                      # whether device placement may be optimized
+    mesh: object                      # jax.sharding.Mesh over the device grid
+    quiet: bool
+    distributed: bool = False         # whether jax.distributed was initialized
+
+    @property
+    def needs_cpu_sync(self) -> bool:
+        """True on a multi-device *CPU* mesh (the test/dev platform): XLA:CPU's
+        in-process collectives can starve their rendezvous when many collective
+        programs are dispatched without synchronization (fatal 40s timeout in
+        `xla::cpu::InProcessCommunicator`).  The library's call surfaces
+        (`update_halo`, `sharded`) block on their results when this is set.
+        On TPU, deep async dispatch of collective programs is the intended
+        execution model and no throttling happens."""
+        try:
+            platform = next(iter(self.mesh.devices.flat)).platform
+        except (AttributeError, StopIteration):
+            return False
+        return platform == "cpu" and self.nprocs > 1
+
+    # -- coordinate/topology helpers (pure functions of the static topology) --
+
+    def cart_rank(self, coords) -> int:
+        """Flat rank of grid coordinates (x fastest, matching the memory
+        layout of gathered arrays, cf. `/root/reference/src/gather.jl:55`)."""
+        cx, cy, cz = (int(c) for c in coords)
+        dx, dy, dz = self.dims
+        if not (0 <= cx < dx and 0 <= cy < dy and 0 <= cz < dz):
+            raise ValueError(f"coords {coords} out of bounds for dims {self.dims}")
+        return cx + cy * dx + cz * dx * dy
+
+    def cart_coords(self, rank: int) -> Tuple[int, int, int]:
+        """Inverse of :meth:`cart_rank`."""
+        dx, dy, dz = self.dims
+        if not 0 <= rank < self.nprocs:
+            raise ValueError(f"rank {rank} out of range for nprocs {self.nprocs}")
+        return (rank % dx, (rank // dx) % dy, rank // (dx * dy))
+
+    def neighbors_of(self, coords, dim: int) -> Tuple[int, int]:
+        """(left, right) neighbor ranks of `coords` along `dim`, or PROC_NULL.
+
+        Equivalent of the reference's `MPI.Cart_shift`-built neighbor table
+        (`/root/reference/src/init_global_grid.jl:78-81`).
+        """
+        c = list(int(x) for x in coords)
+        n = self.dims[dim]
+        out = []
+        for step in (-self.disp, self.disp):
+            t = c[dim] + step
+            if self.periods[dim]:
+                t %= n
+            if 0 <= t < n:
+                cc = list(c)
+                cc[dim] = t
+                out.append(self.cart_rank(cc))
+            else:
+                out.append(PROC_NULL)
+        return tuple(out)
+
+    def neighbors(self, dim: int) -> Tuple[int, int]:
+        """(left, right) neighbors of *this process's* coords along `dim`."""
+        return self.neighbors_of(self.coords, dim)
+
+    def has_neighbor(self, n: int, dim: int) -> bool:
+        """Whether neighbor `n` (0=left, 1=right) exists along `dim`
+        (reference `/root/reference/src/shared.jl:88`)."""
+        return self.neighbors(dim)[n] != PROC_NULL
+
+    # -- per-array helpers --
+
+    def local_shape(self, A) -> Tuple[int, ...]:
+        """Per-device shape of a stacked global array `A`.
+
+        Arrays in this framework are 'block-stacked' global jax.Arrays of
+        shape `dims * local_shape`, sharded so each device holds exactly the
+        reference's local array (halos included).
+        """
+        shp = []
+        for d in range(A.ndim):
+            nd = self.dims[d] if d < NDIMS else 1
+            if A.shape[d] % nd != 0:
+                raise ValueError(
+                    f"array dim {d} of size {A.shape[d]} is not divisible by "
+                    f"the device grid dims[{d}]={nd}; arrays must be created "
+                    f"with igg.zeros()/igg.full() or have a dims-divisible shape.")
+            shp.append(A.shape[d] // nd)
+        return tuple(shp)
+
+    def local_shape_any(self, A) -> Tuple[int, ...]:
+        """Per-device shape of `A`, which may be a stacked global jax.Array
+        (has a `.sharding`) or a host array already of local shape (the
+        reference's model where users own plain local arrays)."""
+        if hasattr(A, "sharding"):
+            return self.local_shape(A)
+        return tuple(A.shape)
+
+    def ol_of_local(self, dim: int, local_shape) -> int:
+        """Overlap along `dim` for an array of per-device shape `local_shape`;
+        per-array staggered adjustment as in the reference
+        (`/root/reference/src/shared.jl:80-81`):
+        `ol(dim, A) = overlaps[dim] + (size_local(A, dim) - nxyz[dim])`."""
+        return self.overlaps[dim] + (local_shape[dim] - self.nxyz[dim])
+
+    def ol(self, dim: int, A=None) -> int:
+        """Overlap of array `A` along `dim` (see :meth:`ol_of_local`)."""
+        if A is None:
+            return self.overlaps[dim]
+        if dim >= A.ndim:
+            raise ValueError(f"array has no dimension {dim}")
+        return self.ol_of_local(dim, self.local_shape_any(A))
+
+
+# ---------------------------------------------------------------------------
+# Module-level grid handle (API-parity with the reference's singleton,
+# `/root/reference/src/shared.jl:57-68`).
+# ---------------------------------------------------------------------------
+
+_global_grid: Optional[GlobalGrid] = None
+# Monotonic epoch; bumped at every init/finalize so compiled-function caches
+# keyed on it cannot leak across grid lifetimes.
+_grid_epoch: int = 0
+
+
+class GridError(RuntimeError):
+    """Error raised for grid lifecycle / argument violations."""
+
+
+def grid_is_initialized() -> bool:
+    return _global_grid is not None
+
+
+def check_initialized() -> None:
+    """Reference `/root/reference/src/shared.jl:64` (same error semantics)."""
+    if not grid_is_initialized():
+        raise GridError(
+            "No function of the module can be called before init_global_grid() "
+            "or after finalize_global_grid().")
+
+
+def global_grid() -> GlobalGrid:
+    check_initialized()
+    return _global_grid
+
+
+def get_global_grid() -> GlobalGrid:
+    """Return the current grid (immutable, so no defensive copy is needed —
+    the reference deep-copies because its struct holds mutable vectors,
+    `/root/reference/src/shared.jl:67`)."""
+    return global_grid()
+
+
+def set_global_grid(gg: Optional[GlobalGrid]) -> None:
+    global _global_grid, _grid_epoch
+    _global_grid = gg
+    _grid_epoch += 1
+
+
+def grid_epoch() -> int:
+    return _grid_epoch
+
+
+# Convenience accessors mirroring the reference's syntax sugar
+# (`/root/reference/src/shared.jl:74-92`).
+
+def me() -> int:
+    return global_grid().me
+
+
+def comm():
+    """The 'communicator': the JAX device mesh of the grid."""
+    return global_grid().mesh
+
+
+def ol(dim: int, A=None) -> int:
+    return global_grid().ol(dim, A)
+
+
+def neighbors(dim: int):
+    return global_grid().neighbors(dim)
+
+
+def neighbor(n: int, dim: int) -> int:
+    return global_grid().neighbors(dim)[n]
+
+
+def has_neighbor(n: int, dim: int) -> bool:
+    return global_grid().has_neighbor(n, dim)

@@ -1,0 +1,200 @@
+"""Global sizes, global coordinates and barrier timers.
+
+Counterpart of `/root/reference/src/tools.jl`.  The scalar forms
+(`x_g(ix, dx, A)`) mirror the reference API (with 0-based `ix`, Python
+convention); the field forms (`x_g_field`) are the TPU-idiomatic way to build
+globally-consistent initial conditions: they return sharded coordinate arrays
+computed locally on every device (pure elementwise functions of an iota — no
+communication).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import shared
+from .shared import NDIMS, check_initialized, global_grid
+
+
+# ---------------------------------------------------------------------------
+# Global sizes (`/root/reference/src/tools.jl:28-63`)
+# ---------------------------------------------------------------------------
+
+def nx_g(A=None) -> int:
+    """Size of the global grid in x; with an array argument, the global size
+    of that (possibly staggered) array (`/root/reference/src/tools.jl:49`)."""
+    g = global_grid()
+    if A is None:
+        return g.nxyz_g[0]
+    return g.nxyz_g[0] + (g.local_shape_any(A)[0] - g.nxyz[0])
+
+
+def ny_g(A=None) -> int:
+    g = global_grid()
+    if A is None:
+        return g.nxyz_g[1]
+    s = g.local_shape_any(A)
+    return g.nxyz_g[1] + ((s[1] if A.ndim > 1 else 1) - g.nxyz[1])
+
+
+def nz_g(A=None) -> int:
+    g = global_grid()
+    if A is None:
+        return g.nxyz_g[2]
+    s = g.local_shape_any(A)
+    return g.nxyz_g[2] + ((s[2] if A.ndim > 2 else 1) - g.nxyz[2])
+
+
+# ---------------------------------------------------------------------------
+# Global coordinates (`/root/reference/src/tools.jl:100-109`)
+# ---------------------------------------------------------------------------
+
+def _coord_g(dim: int, i, d, local_size: int, coord, grid) -> float:
+    """Shared formula of x_g/y_g/z_g for 0-based index `i` (works for scalars
+    and jnp arrays).  Staggered centering: a larger-than-base array extends
+    half a cell beyond the base grid on each side."""
+    import jax.numpy as jnp
+    n = grid.nxyz[dim]
+    ng = grid.nxyz_g[dim]
+    old = grid.overlaps[dim]
+    x0 = 0.5 * (n - local_size) * d
+    x = (coord * (n - old) + i) * d + x0
+    if grid.periods[dim]:
+        # The first cell of a periodic global problem is a ghost cell: shift
+        # by one cell and wrap into [0, ng*d) (`/root/reference/src/tools.jl:103-107`).
+        x = x - d
+        if isinstance(x, (int, float, np.floating)):
+            if x > (ng - 1) * d:
+                x = x - ng * d
+            if x < 0:
+                x = x + ng * d
+        else:
+            x = jnp.where(x > (ng - 1) * d, x - ng * d, x)
+            x = jnp.where(x < 0, x + ng * d, x)
+    return x
+
+
+def _scalar_coord(dim: int, i: int, d, A, coords) -> float:
+    check_initialized()
+    g = global_grid()
+    s = g.local_shape_any(A)
+    local_size = s[dim] if A.ndim > dim else 1
+    c = (coords if coords is not None else g.coords)[dim]
+    return _coord_g(dim, i, d, local_size, c, g)
+
+
+def x_g(ix: int, dx, A, coords: Optional[Sequence[int]] = None) -> float:
+    """Global x-coordinate of element `ix` (0-based) of the local array `A`
+    (`dx` = spacing).  `coords` selects the grid coordinates of the device the
+    element lives on (default: this process's coords)."""
+    return _scalar_coord(0, ix, dx, A, coords)
+
+
+def y_g(iy: int, dy, A, coords: Optional[Sequence[int]] = None) -> float:
+    return _scalar_coord(1, iy, dy, A, coords)
+
+
+def z_g(iz: int, dz, A, coords: Optional[Sequence[int]] = None) -> float:
+    return _scalar_coord(2, iz, dz, A, coords)
+
+
+def _coord_field(dim: int, d, A):
+    """1-D sharded array of global coordinates along `dim` of the stacked
+    array `A`: entry I (stacked index) is the coordinate of local element
+    I % s on the device at grid position I // s.  Elementwise in an iota, so
+    every device computes exactly its own shard — no communication."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    check_initialized()
+    g = global_grid()
+    s = g.local_shape_any(A)
+    local_size = s[dim] if A.ndim > dim else 1
+    S = local_size * (g.dims[dim] if dim < NDIMS else 1)
+    axis = shared.AXIS_NAMES[dim]
+    sharding = NamedSharding(g.mesh, P(axis))
+
+    def build():
+        I = jnp.arange(S)
+        c = I // local_size
+        i = I % local_size
+        return _coord_g(dim, i.astype(jnp.float64 if jax.config.jax_enable_x64
+                                      else jnp.float32), float(d), local_size, c, g)
+
+    return jax.jit(build, out_shardings=sharding)()
+
+
+def x_g_field(dx, A):
+    """Sharded 1-D array of the global x-coordinates of every element of `A`
+    along the stacked x-dimension; broadcast against `A` for initialization
+    (e.g. ``X = x_g_field(dx, T)[:, None, None]``)."""
+    return _coord_field(0, dx, A)
+
+
+def y_g_field(dy, A):
+    return _coord_field(1, dy, A)
+
+
+def z_g_field(dz, A):
+    return _coord_field(2, dz, A)
+
+
+def coord_fields(dx, dy, dz, A) -> Tuple:
+    """(X, Y, Z) coordinate arrays broadcastable against the 3-D array `A` —
+    the idiomatic replacement of the reference's
+    `[x_g(ix,dx,A) for ix=...]` comprehension initialization
+    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:34-37`)."""
+    X = x_g_field(dx, A)[:, None, None]
+    Y = y_g_field(dy, A)[None, :, None]
+    Z = z_g_field(dz, A)[None, None, :]
+    return X, Y, Z
+
+
+# ---------------------------------------------------------------------------
+# Barrier-synchronized chronometer (`/root/reference/src/tools.jl:228-234`)
+# ---------------------------------------------------------------------------
+
+_t0: Optional[float] = None
+
+
+def barrier() -> None:
+    """Wait until all devices of the grid have drained their work queues (and
+    all hosts have synchronized, in multi-host runs) — the role MPI.Barrier
+    plays in the reference timers (`/root/reference/src/tools.jl:232-233`).
+
+    TPU cores execute their queue in order, so blocking on a trivial
+    computation enqueued *now* waits for everything enqueued before it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    check_initialized()
+    g = global_grid()
+    local = set(jax.local_devices())
+    tokens = [jax.device_put(np.zeros((), np.float32), d)
+              for d in g.mesh.devices.flat if d in local]
+    jax.block_until_ready([t + 1.0 for t in tokens])
+    if g.distributed:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("igg_barrier")
+
+
+def tic() -> None:
+    """Start the chronometer once all devices have reached this point."""
+    global _t0
+    check_initialized()
+    barrier()
+    _t0 = time.monotonic()
+
+
+def toc() -> float:
+    """Elapsed seconds since `tic()`, after all devices reach this point."""
+    check_initialized()
+    if _t0 is None:
+        raise shared.GridError("toc() called before tic().")
+    barrier()
+    return time.monotonic() - _t0

@@ -1,0 +1,106 @@
+"""Shared test helpers: the coordinate-encoding oracle of the reference suite.
+
+The reference fills arrays with globally-encoded coordinates
+`z_g*1e2 + y_g*1e1 + x_g`, zeroes the boundary planes, runs `update_halo!`
+and asserts the array equals its backup
+(`/root/reference/test/test_update_halo.jl:654,685-697`).  The encoding makes
+overlapping cells of neighboring blocks carry identical values (staggered and
+periodic cases included), so a correct halo exchange exactly restores what
+was zeroed.
+"""
+
+import numpy as np
+
+import igg
+
+
+def encoded_block(coords, lshape, d=1.0):
+    """Local block filled with z_g*100 + y_g*10 + x_g for grid `coords`."""
+    probe = np.empty(lshape)  # carries local shape/ndim for the *_g tools
+    nd = len(lshape)
+    xs = np.array([igg.x_g(i, d, probe, coords) for i in range(lshape[0])])
+    out = xs
+    if nd >= 2:
+        ys = np.array([igg.y_g(i, d, probe, coords) for i in range(lshape[1])])
+        out = out[:, None] + 10.0 * ys[None, :]
+    if nd >= 3:
+        zs = np.array([igg.z_g(i, d, probe, coords) for i in range(lshape[2])])
+        out = out[:, :, None] + 100.0 * zs[None, None, :]
+    return out
+
+
+def encoded_field(lshape, dtype=np.float64):
+    """Stacked grid array with every block coordinate-encoded."""
+    return igg.from_local_blocks(
+        lambda coords, ls: encoded_block(coords, ls), lshape, dtype=dtype)
+
+
+def halo_dims(lshape):
+    """Array dims that have a halo (ol >= 2), cf.
+    `/root/reference/src/update_halo.jl:284`."""
+    g = igg.get_global_grid()
+    return [d for d in range(min(len(lshape), igg.NDIMS))
+            if g.ol_of_local(d, lshape) >= 2]
+
+
+def zero_halo_blocks(stacked, lshape):
+    """Zero the outermost planes of every local block in every halo dim."""
+    g = igg.get_global_grid()
+    out = np.array(stacked)
+    nd = len(lshape)
+    dims = [g.dims[d] if d < igg.NDIMS else 1 for d in range(nd)]
+    hdims = halo_dims(lshape)
+    for cz in range(dims[2] if nd > 2 else 1):
+        for cy in range(dims[1] if nd > 1 else 1):
+            for cx in range(dims[0]):
+                sl = tuple(slice(c * s, (c + 1) * s)
+                           for c, s in zip((cx, cy, cz)[:nd], lshape))
+                block = out[sl]
+                for d in hdims:
+                    ix = [slice(None)] * nd
+                    ix[d] = 0
+                    block[tuple(ix)] = 0.0
+                    ix[d] = lshape[d] - 1
+                    block[tuple(ix)] = 0.0
+    return out
+
+
+def expected_after_update(backup, zeroed, lshape):
+    """Expected result of update_halo on the zeroed field: the backup, except
+    that edge blocks of non-periodic dims keep their zeroed outer plane
+    (open-boundary no-write, `/root/reference/test/test_update_halo.jl:727-732`)."""
+    g = igg.get_global_grid()
+    out = np.array(backup)
+    nd = len(lshape)
+    dims = [g.dims[d] if d < igg.NDIMS else 1 for d in range(nd)]
+    hdims = halo_dims(lshape)
+    for cz in range(dims[2] if nd > 2 else 1):
+        for cy in range(dims[1] if nd > 1 else 1):
+            for cx in range(dims[0]):
+                c = (cx, cy, cz)
+                sl = tuple(slice(cc * s, (cc + 1) * s)
+                           for cc, s in zip(c[:nd], lshape))
+                for d in hdims:
+                    if g.periods[d]:
+                        continue
+                    if c[d] == 0:
+                        ix = [slice(None)] * nd
+                        ix[d] = 0
+                        out[sl][tuple(ix)] = zeroed[sl][tuple(ix)]
+                    if c[d] == dims[d] - 1:
+                        ix = [slice(None)] * nd
+                        ix[d] = lshape[d] - 1
+                        out[sl][tuple(ix)] = zeroed[sl][tuple(ix)]
+    return out
+
+
+def roundtrip(lshape, dtype=np.float64):
+    """Run the full oracle: encode → zero halos → update_halo → (result,
+    expected)."""
+    import jax
+    field = encoded_field(lshape, dtype=dtype)
+    backup = np.array(field)
+    zeroed = zero_halo_blocks(backup, lshape)
+    A = jax.device_put(zeroed, igg.sharding_for(len(lshape)))
+    out = np.array(igg.update_halo(A))
+    return out, expected_after_update(backup, zeroed, lshape)

@@ -1,0 +1,112 @@
+"""Lifecycle + topology tests.
+
+Ports the coverage of `/root/reference/test/test_init_global_grid.jl`:
+init/finalize lifecycle, return values, full grid-state check, periodic
+`nxyz_g` arithmetic, non-default overlaps, and the argument error cases.
+"""
+
+import numpy as np
+import pytest
+
+import igg
+from igg.topology import dims_create
+
+
+def test_initialization_and_return_values():
+    me, dims, nprocs, coords, mesh = igg.init_global_grid(4, 4, 4, quiet=True)
+    assert igg.grid_is_initialized()
+    assert me == 0
+    assert nprocs == 8
+    assert tuple(sorted(dims, reverse=True)) == dims  # balanced, non-increasing
+    assert int(np.prod(dims)) == 8
+    assert mesh is igg.get_global_grid().mesh
+    assert mesh.axis_names == igg.AXIS_NAMES
+    assert tuple(mesh.devices.shape) == dims
+    igg.finalize_global_grid()
+    assert not igg.grid_is_initialized()
+
+
+def test_grid_state_fields():
+    igg.init_global_grid(5, 6, 7, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    g = igg.get_global_grid()
+    assert g.nxyz == (5, 6, 7)
+    assert g.dims == (2, 2, 2)
+    assert g.overlaps == (2, 2, 2)
+    assert g.periods == (1, 0, 0)
+    assert g.nprocs == 8
+    assert g.disp == 1 and g.reorder == 1
+    # nxyz_g = dims*(nxyz-overlaps) + overlaps*(periods==0)
+    # (`/root/reference/src/init_global_grid.jl:82`)
+    assert g.nxyz_g == (2 * 3, 2 * 4 + 2, 2 * 5 + 2)
+    assert igg.nx_g() == 6 and igg.ny_g() == 10 and igg.nz_g() == 12
+
+
+def test_nonperiodic_vs_periodic_global_size():
+    igg.init_global_grid(8, 8, 8, quiet=True)  # dims (2,2,2), all open
+    assert (igg.nx_g(), igg.ny_g(), igg.nz_g()) == (14, 14, 14)
+    igg.finalize_global_grid()
+    igg.init_global_grid(8, 8, 8, periodx=1, periody=1, periodz=1, quiet=True)
+    assert (igg.nx_g(), igg.ny_g(), igg.nz_g()) == (12, 12, 12)
+
+
+def test_non_default_overlaps():
+    igg.init_global_grid(8, 8, 8, overlapx=3, overlapy=4, quiet=True)
+    g = igg.get_global_grid()
+    assert g.overlaps == (3, 4, 2)
+    assert g.nxyz_g == (2 * 5 + 3, 2 * 4 + 4, 2 * 6 + 2)
+
+
+def test_neighbors_and_ranks():
+    igg.init_global_grid(4, 4, 4, periodx=1, quiet=True)  # dims (2,2,2)
+    g = igg.get_global_grid()
+    # x periodic: both neighbors exist everywhere and wrap.
+    assert g.neighbors_of((0, 0, 0), 0) == (g.cart_rank((1, 0, 0)),
+                                            g.cart_rank((1, 0, 0)))
+    # y open: left edge has no left neighbor.
+    assert g.neighbors_of((0, 0, 0), 1)[0] == igg.PROC_NULL
+    assert g.neighbors_of((0, 1, 0), 1)[1] == igg.PROC_NULL
+    # rank <-> coords round trip
+    for r in range(g.nprocs):
+        assert g.cart_rank(g.cart_coords(r)) == r
+
+
+def test_dims_create():
+    assert dims_create(8, (0, 0, 0)) == (2, 2, 2)
+    assert dims_create(12, (0, 0, 0)) == (3, 2, 2)
+    assert dims_create(16, (0, 0, 0)) == (4, 2, 2)
+    assert dims_create(6, (0, 0, 1)) == (3, 2, 1)
+    assert dims_create(8, (2, 0, 0)) == (2, 2, 2)
+    assert dims_create(8, (8, 1, 1)) == (8, 1, 1)
+    assert dims_create(7, (0, 1, 1)) == (7, 1, 1)
+    with pytest.raises(igg.GridError):
+        dims_create(8, (3, 0, 0))  # 3 does not divide 8
+
+
+def test_error_cases():
+    # (`/root/reference/src/init_global_grid.jl:43,62-66` /
+    #  `/root/reference/test/test_init_global_grid.jl`)
+    with pytest.raises(igg.GridError, match="nx can never be 1"):
+        igg.init_global_grid(1, 4, 4, quiet=True)
+    with pytest.raises(igg.GridError, match="ny cannot be 1"):
+        igg.init_global_grid(4, 1, 4, quiet=True)
+    with pytest.raises(igg.GridError, match="Incoherent arguments"):
+        igg.init_global_grid(4, 4, 1, dimz=2, quiet=True)
+    with pytest.raises(igg.GridError, match="Incoherent arguments"):
+        igg.init_global_grid(4, 4, 2, periodz=1, quiet=True)  # nz < 2*ol-1
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    with pytest.raises(igg.GridError, match="already been initialized"):
+        igg.init_global_grid(4, 4, 4, quiet=True)
+
+
+def test_nz1_forces_dimz_1():
+    me, dims, nprocs, *_ = igg.init_global_grid(8, 8, 1, quiet=True)
+    assert dims[2] == 1
+    assert nprocs == 8
+
+
+def test_check_initialized_guard():
+    with pytest.raises(igg.GridError, match="init_global_grid"):
+        igg.nx_g()
+    with pytest.raises(igg.GridError, match="init_global_grid"):
+        igg.tic()

@@ -1,0 +1,44 @@
+"""Finalize + select_device tests
+(`/root/reference/test/test_finalize_global_grid.jl`,
+`/root/reference/test/test_select_device.jl`)."""
+
+import pytest
+
+import igg
+from igg import halo
+
+
+def test_finalize_clears_everything():
+    igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+    A = igg.zeros((6, 6, 6))
+    igg.update_halo(A)
+    assert len(halo._compiled) > 0
+    igg.finalize_global_grid()
+    assert not igg.grid_is_initialized()
+    assert len(halo._compiled) == 0
+
+
+def test_double_finalize_errors():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.finalize_global_grid()
+    with pytest.raises(igg.GridError):
+        igg.finalize_global_grid()
+
+
+def test_select_device():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    assert isinstance(igg.select_device(), int)
+
+
+def test_select_device_requires_init():
+    with pytest.raises(igg.GridError):
+        igg.select_device()
+
+
+def test_reinit_after_finalize():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.finalize_global_grid()
+    me, dims, nprocs, *_ = igg.init_global_grid(6, 6, 6, quiet=True)
+    assert nprocs == 8
+    A = igg.zeros((6, 6, 6))
+    igg.update_halo(A)

@@ -1,0 +1,165 @@
+"""Coordinate-tool tests: ports the golden tables of
+`/root/reference/test/test_tools.jl` (indices shifted to 0-based)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import igg
+from igg import shared
+
+
+def seq(fn, n, d, A, coords=None):
+    return [fn(i, d, A, coords) for i in range(n)]
+
+
+class TestGFunctions:
+    """`/root/reference/test/test_tools.jl:15-66` (1-device grid, periodz)."""
+
+    def setup_method(self, _):
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+        self.nx, self.ny, self.nz = 5, 5, 5
+        igg.init_global_grid(self.nx, self.ny, self.nz, dimx=1, dimy=1,
+                             dimz=1, periodz=1, quiet=True)
+        self.P = np.zeros((5, 5, 5))
+        self.Vx = np.zeros((6, 5, 5))
+        self.Vz = np.zeros((5, 5, 6))
+        self.A = np.zeros((5, 5, 7))
+        self.Sxz = np.zeros((3, 4, 3))
+
+    def test_n_g(self):
+        assert igg.nx_g() == 5
+        assert igg.ny_g() == 5
+        assert igg.nz_g() == 3
+        assert igg.nx_g(self.Vx) == 6
+        assert igg.nz_g(self.Vz) == 4
+        assert igg.nz_g(self.A) == 5
+        assert igg.nx_g(self.Sxz) == 3
+
+    def test_xyz_g(self):
+        dx = 8 / (igg.nx_g() - 1)
+        dy = 8 / (igg.ny_g() - 1)
+        dz = 8 / (igg.nz_g() - 1)
+        assert seq(igg.x_g, 5, dx, self.P) == [0, 2, 4, 6, 8]
+        assert seq(igg.y_g, 5, dy, self.P) == [0, 2, 4, 6, 8]
+        assert seq(igg.z_g, 5, dz, self.P) == [8, 0, 4, 8, 0]
+        assert seq(igg.x_g, 6, dx, self.Vx) == [-1, 1, 3, 5, 7, 9]
+        assert seq(igg.y_g, 5, dy, self.Vx) == [0, 2, 4, 6, 8]
+        assert seq(igg.z_g, 5, dz, self.Vx) == [8, 0, 4, 8, 0]
+        assert seq(igg.x_g, 5, dx, self.Vz) == [0, 2, 4, 6, 8]
+        assert seq(igg.z_g, 6, dz, self.Vz) == [6, 10, 2, 6, 10, 2]
+        assert seq(igg.z_g, 7, dz, self.A) == [4, 8, 0, 4, 8, 0, 4]
+        assert seq(igg.x_g, 3, dx, self.Sxz) == [2, 4, 6]
+        assert seq(igg.y_g, 4, dy, self.Sxz) == [1, 3, 5, 7]
+        assert seq(igg.z_g, 3, dz, self.Sxz) == [0, 4, 8]
+
+    def test_field_forms_match_scalars(self):
+        dz = 8 / (igg.nz_g() - 1)
+        Vz = igg.zeros((5, 5, 6))
+        zf = np.array(igg.z_g_field(dz, Vz))
+        assert zf.tolist() == [6, 10, 2, 6, 10, 2]
+
+
+class TestGFunctionsNonDefaultOverlap:
+    """`/root/reference/test/test_tools.jl:68-114` (overlapx=3, overlapz=3)."""
+
+    def setup_method(self, _):
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+        igg.init_global_grid(5, 5, 8, dimx=1, dimy=1, dimz=1, periodz=1,
+                             overlapx=3, overlapz=3, quiet=True)
+
+    def test_n_g(self):
+        assert igg.nx_g() == 5
+        assert igg.ny_g() == 5
+        assert igg.nz_g() == 5
+
+    def test_xyz_g(self):
+        dx = 8 / (igg.nx_g() - 1)
+        dy = 8 / (igg.ny_g() - 1)
+        dz = 8 / (igg.nz_g() - 1)
+        P = np.zeros((5, 5, 8))
+        Vz = np.zeros((5, 5, 9))
+        A = np.zeros((5, 5, 10))
+        Sxz = np.zeros((3, 4, 6))
+        assert seq(igg.x_g, 5, dx, P) == [0, 2, 4, 6, 8]
+        assert seq(igg.z_g, 8, dz, P) == [8, 0, 2, 4, 6, 8, 0, 2]
+        assert seq(igg.z_g, 9, dz, Vz) == [7, 9, 1, 3, 5, 7, 9, 1, 3]
+        assert seq(igg.z_g, 10, dz, A) == [6, 8, 0, 2, 4, 6, 8, 0, 2, 4]
+        assert seq(igg.z_g, 6, dz, Sxz) == [0, 2, 4, 6, 8, 0]
+
+
+class TestSimulatedTopology:
+    """`/root/reference/test/test_tools.jl:116-166`: a 3x3x3 grid simulated on
+    one device by swapping in modified grid state (here: an immutable replace
+    + explicit coords, instead of mutating the struct's vectors)."""
+
+    def setup_method(self, _):
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+        igg.init_global_grid(5, 5, 5, dimx=1, dimy=1, dimz=1, periodz=1,
+                             quiet=True)
+        g = igg.get_global_grid()
+        dims = (3, 3, 3)
+        nxyz_g = tuple(
+            dims[d] * (g.nxyz[d] - g.overlaps[d])
+            + g.overlaps[d] * (g.periods[d] == 0) for d in range(3))
+        shared.set_global_grid(dataclasses.replace(g, dims=dims,
+                                                   nxyz_g=nxyz_g,
+                                                   nprocs=27))
+        self.P = np.zeros((5, 5, 5))
+        self.A = np.zeros((6, 3, 7))
+
+    def test_n_g(self):
+        assert igg.nx_g() == 11 and igg.ny_g() == 11 and igg.nz_g() == 9
+
+    def test_xyz_g_per_coords(self):
+        dx = 20 / (igg.nx_g() - 1)
+        dy = 20 / (igg.ny_g() - 1)
+        dz = 16 / (igg.nz_g() - 1)
+        P, A = self.P, self.A
+        assert seq(igg.x_g, 5, dx, P, (0, 0, 0)) == [0, 2, 4, 6, 8]
+        assert seq(igg.x_g, 5, dx, P, (1, 0, 0)) == [6, 8, 10, 12, 14]
+        assert seq(igg.x_g, 5, dx, P, (2, 0, 0)) == [12, 14, 16, 18, 20]
+        assert seq(igg.y_g, 5, dy, P, (0, 0, 0)) == [0, 2, 4, 6, 8]
+        assert seq(igg.y_g, 5, dy, P, (0, 1, 0)) == [6, 8, 10, 12, 14]
+        assert seq(igg.y_g, 5, dy, P, (0, 2, 0)) == [12, 14, 16, 18, 20]
+        assert seq(igg.z_g, 5, dz, P, (0, 0, 0)) == [16, 0, 2, 4, 6]
+        assert seq(igg.z_g, 5, dz, P, (0, 0, 1)) == [4, 6, 8, 10, 12]
+        assert seq(igg.z_g, 5, dz, P, (0, 0, 2)) == [10, 12, 14, 16, 0]
+        assert seq(igg.x_g, 6, dx, A, (0, 0, 0)) == [-1, 1, 3, 5, 7, 9]
+        assert seq(igg.x_g, 6, dx, A, (1, 0, 0)) == [5, 7, 9, 11, 13, 15]
+        assert seq(igg.x_g, 6, dx, A, (2, 0, 0)) == [11, 13, 15, 17, 19, 21]
+        assert seq(igg.y_g, 3, dy, A, (0, 0, 0)) == [2, 4, 6]
+        assert seq(igg.y_g, 3, dy, A, (0, 1, 0)) == [8, 10, 12]
+        assert seq(igg.y_g, 3, dy, A, (0, 2, 0)) == [14, 16, 18]
+        assert seq(igg.z_g, 7, dz, A, (0, 0, 0)) == [14, 16, 0, 2, 4, 6, 8]
+        assert seq(igg.z_g, 7, dz, A, (0, 0, 1)) == [2, 4, 6, 8, 10, 12, 14]
+        assert seq(igg.z_g, 7, dz, A, (0, 0, 2)) == [8, 10, 12, 14, 16, 0, 2]
+
+
+def test_tic_toc():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.tic()
+    t = igg.toc()
+    assert t >= 0.0
+    igg.tic()
+    assert igg.toc() <= 1.0
+
+
+def test_coord_fields_broadcast():
+    igg.init_global_grid(4, 4, 4, periodx=1, periody=1, periodz=1, quiet=True)
+    T = igg.zeros((4, 4, 4))
+    X, Y, Z = igg.coord_fields(1.0, 1.0, 1.0, T)
+    F = X + Y + Z + 0 * T
+    assert F.shape == T.shape
+    # spot-check against the scalar form
+    g = igg.get_global_grid()
+    F_np = np.array(F)
+    probe = np.zeros((4, 4, 4))
+    for c in [(0, 0, 0), (1, 1, 1), (1, 0, 1)]:
+        val = (igg.x_g(2, 1.0, probe, c) + igg.y_g(1, 1.0, probe, c)
+               + igg.z_g(3, 1.0, probe, c))
+        assert F_np[c[0] * 4 + 2, c[1] * 4 + 1, c[2] * 4 + 3] == pytest.approx(val)
