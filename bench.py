@@ -1,0 +1,58 @@
+"""Headline benchmark: 3-D heat diffusion, 256^3 per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (see BASELINE.md): the reference reports 29 min wall-clock
+for 100k steps of 3-D heat diffusion on a 510^3 global grid over 8x NVIDIA
+P100 (255^3 per GPU, CuArray-broadcast version) on Piz Daint
+(`/root/reference/README.md:158-162`) — i.e. 17.4 ms/step/GPU.  We run the
+same physics at 256^3 per chip and report ms/step; `vs_baseline` is the
+speedup over 17.4 ms (>1 = faster than the reference's published number).
+
+The grid is fully periodic so the halo path executes even on one chip (the
+self-wrap branch, the same planes-moved per step as an interior rank).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import igg
+    from igg.models import diffusion3d as d3
+
+    platform = jax.devices()[0].platform
+    n = 256 if platform != "cpu" else 64
+    nt, n_inner = (5, 100) if platform != "cpu" else (2, 10)
+
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params()
+    T, sec_per_step = d3.run(nt, params, dtype=np.float32, n_inner=n_inner)
+    ms = sec_per_step * 1e3
+
+    # Effective throughput for context (bytes touched per step, ideal-fusion
+    # estimate: read T, Cp; write T).
+    cells = float(np.prod(T.shape))
+    gbps = 3 * cells * 4 / sec_per_step / 1e9
+
+    baseline_ms = 17.4  # ms/step/GPU, reference 510^3 on 8x P100
+    result = {
+        "metric": f"diffusion3d_{n}cubed_ms_per_step",
+        "value": round(ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / ms, 3) if n == 256 else None,
+    }
+    print(f"[bench] platform={platform} devices={grid.nprocs} "
+          f"dims={grid.dims} local={n}^3 steps={nt} "
+          f"~{gbps:.1f} GB/s effective", file=sys.stderr)
+    igg.finalize_global_grid()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

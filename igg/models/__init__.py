@@ -1,0 +1,11 @@
+"""Physics models built on the implicit global grid.
+
+The reference ships its models as example scripts
+(`/root/reference/docs/examples/diffusion3D_*.jl`); here they are importable
+modules so benchmarks, tests and the graft entry points share one
+implementation.
+"""
+
+from . import diffusion3d
+
+__all__ = ["diffusion3d"]

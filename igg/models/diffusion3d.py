@@ -1,0 +1,182 @@
+"""3-D heat diffusion — the flagship model.
+
+TPU-native re-implementation of the reference's de-facto integration benchmark
+(`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl`):
+Fourier-law fluxes on a staggered grid, conservative temperature update, halo
+exchange each step.  The whole step (fluxes + update + halo ppermutes)
+compiles to ONE XLA program per device via `igg.sharded`, with the temperature
+buffer donated so the update is in-place in HBM; XLA's latency-hiding
+scheduler overlaps the halo collectives with interior compute — the built-in
+analog of ParallelStencil's `@hide_communication`
+(`/root/reference/README.md:9`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+import igg
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    lam: float = 1.0        # thermal conductivity
+    cp_min: float = 1.0     # minimal heat capacity
+    lx: float = 10.0        # domain length in x
+    ly: float = 10.0
+    lz: float = 10.0
+
+    def spacing(self) -> Tuple[float, float, float]:
+        return (self.lx / (igg.nx_g() - 1),
+                self.ly / (igg.ny_g() - 1),
+                self.lz / (igg.nz_g() - 1))
+
+    def timestep(self) -> float:
+        dx, dy, dz = self.spacing()
+        # CFL-type bound of the reference example
+        # (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:40`).
+        return min(dx * dx, dy * dy, dz * dz) * self.cp_min / self.lam / 8.1
+
+
+def init_fields(params: Params = Params(), dtype=np.float32):
+    """Heat capacity and temperature with Gaussian anomalies, built from
+    global coordinates so every device holds globally-consistent data
+    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:33-37`)."""
+    import jax.numpy as jnp
+
+    grid = igg.get_global_grid()
+    nx, ny, nz = grid.nxyz
+    dx, dy, dz = params.spacing()
+    lx, ly, lz = params.lx, params.ly, params.lz
+
+    T0 = igg.zeros((nx, ny, nz), dtype=dtype)
+    X, Y, Z = igg.coord_fields(dx, dy, dz, T0)
+    X, Y, Z = (a.astype(dtype) for a in (X, Y, Z))
+    Cp = (params.cp_min
+          + 5 * jnp.exp(-(X - lx / 1.5) ** 2 - (Y - ly / 2) ** 2 - (Z - lz / 1.5) ** 2)
+          + 5 * jnp.exp(-(X - lx / 3.0) ** 2 - (Y - ly / 2) ** 2 - (Z - lz / 1.5) ** 2)
+          + 0 * T0)
+    T = (100 * jnp.exp(-((X - lx / 2) / 2) ** 2 - ((Y - ly / 2) / 2) ** 2
+                       - ((Z - lz / 3.0) / 2) ** 2)
+         + 50 * jnp.exp(-((X - lx / 2) / 2) ** 2 - ((Y - ly / 2) / 2) ** 2
+                        - ((Z - lz / 1.5) / 2) ** 2)
+         + 0 * T0)
+    return T, Cp
+
+
+def local_step(T, Cp, *, dx, dy, dz, dt, lam):
+    """One diffusion step over per-device local arrays (the user-model of the
+    reference: physics written for a single device's block,
+    `/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`)."""
+    # Fourier's law on the staggered inner faces: q = -λ ∂T
+    qx = -lam * (T[1:, 1:-1, 1:-1] - T[:-1, 1:-1, 1:-1]) / dx
+    qy = -lam * (T[1:-1, 1:, 1:-1] - T[1:-1, :-1, 1:-1]) / dy
+    qz = -lam * (T[1:-1, 1:-1, 1:] - T[1:-1, 1:-1, :-1]) / dz
+    # Conservation of energy: ∂T/∂t = 1/cp ∇·q
+    dTdt = (1.0 / Cp[1:-1, 1:-1, 1:-1]) * (
+        -(qx[1:, :, :] - qx[:-1, :, :]) / dx
+        - (qy[:, 1:, :] - qy[:, :-1, :]) / dy
+        - (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
+    T = T.at[1:-1, 1:-1, 1:-1].add(dt * dTdt)
+    return igg.update_halo_local(T)
+
+
+def _pallas_applicable(use_pallas, T) -> bool:
+    import jax.numpy as jnp
+
+    from igg.ops import pallas_supported
+    if use_pallas is False:
+        return False
+    grid = igg.get_global_grid()
+    ok = (pallas_supported(grid, T) and T.dtype == jnp.float32
+          and next(iter(grid.mesh.devices.flat)).platform == "tpu")
+    if use_pallas is True and not ok:
+        raise igg.GridError(
+            "the fused Pallas step requires a single TPU device, a fully "
+            "periodic overlap-2 grid, and an f32 unstaggered field.")
+    return ok
+
+
+def _best_bx(S0: int) -> int:
+    for b in (8, 4, 2):
+        if S0 % b == 0:
+            return b
+    return 1
+
+
+def make_step(params: Params = Params(), *, donate: bool = True,
+              use_pallas="auto"):
+    """Compiled whole-step function `(T, Cp) -> T` over the grid mesh.
+
+    `use_pallas`: "auto" (default) uses the fused Pallas kernel
+    (`igg.ops.fused_diffusion_step`) when it applies (single TPU device,
+    fully-periodic overlap-2 grid, f32); False forces the portable
+    shard_map/XLA path; True requires the kernel and raises if inapplicable.
+    """
+    return make_multi_step(1, params, donate=donate, use_pallas=use_pallas)
+
+
+def make_multi_step(n_inner: int, params: Params = Params(), *,
+                    donate: bool = True, use_pallas="auto"):
+    """Compiled `(T, Cp) -> T` advancing `n_inner` steps in ONE XLA program
+    (`lax.fori_loop` around the step, halo ppermutes included).  This is the
+    TPU-idiomatic time loop: host dispatch overhead amortizes to zero, and
+    XLA schedules collectives of step k+1 against compute of step k.  The
+    reference instead re-dispatches kernels + MPI calls from the host every
+    step (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`)."""
+    import jax
+    from jax import lax
+
+    dx, dy, dz = params.spacing()
+    dt = params.timestep()
+
+    def steps(T, Cp):
+        return lax.fori_loop(
+            0, n_inner,
+            lambda _, T: local_step(T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
+                                    lam=params.lam),
+            T)
+
+    xla_path = igg.sharded(steps, donate_argnums=(0,) if donate else ())
+    cache = {}
+
+    def dispatch(T, Cp):
+        if _pallas_applicable(use_pallas, T):
+            from igg.ops import fused_diffusion_step
+            key = (T.shape, str(T.dtype))
+            fn = cache.get(key)
+            if fn is None:
+                bx = _best_bx(T.shape[0])
+                fn = jax.jit(
+                    lambda T, Cp: lax.fori_loop(
+                        0, n_inner,
+                        lambda _, T: fused_diffusion_step(
+                            T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
+                            lam=params.lam, bx=bx),
+                        T),
+                    donate_argnums=(0,) if donate else ())
+                cache[key] = fn
+            return fn(T, Cp)
+        return xla_path(T, Cp)
+
+    return dispatch
+
+
+def run(nt: int, params: Params = Params(), dtype=np.float32,
+        warmup: int = 1, n_inner: int = 1, use_pallas="auto"):
+    """Run `nt * n_inner` timed steps after exactly `warmup` untimed
+    dispatches (warmup=0 includes compilation in the timing); with
+    `n_inner > 1` each dispatch advances `n_inner` steps inside one compiled
+    program.  Returns (T, seconds_per_step)."""
+    T, Cp = init_fields(params, dtype=dtype)
+    step = make_multi_step(n_inner, params, use_pallas=use_pallas)
+    for _ in range(warmup):
+        T = step(T, Cp)
+    igg.tic()
+    for _ in range(nt):
+        T = step(T, Cp)
+    elapsed = igg.toc()
+    return T, elapsed / (nt * n_inner)

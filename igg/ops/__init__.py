@@ -1,0 +1,11 @@
+"""Hand-optimized TPU kernels for the hot ops (Pallas).
+
+The reference's "native surface" is its CUDA pack/unpack kernels and SIMD
+copies (`/root/reference/src/update_halo.jl:439-462,555-563`); on TPU the
+equivalent layer is Pallas kernels that fuse the stencil update with halo
+maintenance so each time step touches HBM exactly once per array.
+"""
+
+from .diffusion_pallas import fused_diffusion_step, pallas_supported
+
+__all__ = ["fused_diffusion_step", "pallas_supported"]

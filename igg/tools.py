@@ -166,18 +166,21 @@ def barrier() -> None:
     all hosts have synchronized, in multi-host runs) — the role MPI.Barrier
     plays in the reference timers (`/root/reference/src/tools.jl:232-233`).
 
-    TPU cores execute their queue in order, so blocking on a trivial
-    computation enqueued *now* waits for everything enqueued before it.
+    TPU cores execute their queue in order, so fetching the value of a trivial
+    computation enqueued *now* waits for everything enqueued before it.  A
+    device->host value read is used (not `block_until_ready`, which some
+    remote-runtime transports treat as an enqueue acknowledgement rather than
+    a completion wait).
     """
     import jax
-    import jax.numpy as jnp
 
     check_initialized()
     g = global_grid()
     local = set(jax.local_devices())
     tokens = [jax.device_put(np.zeros((), np.float32), d)
               for d in g.mesh.devices.flat if d in local]
-    jax.block_until_ready([t + 1.0 for t in tokens])
+    for t in tokens:
+        np.asarray(t + 1.0)  # device->host read = completion barrier
     if g.distributed:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("igg_barrier")
