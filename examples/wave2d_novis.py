@@ -1,0 +1,32 @@
+"""2-D acoustic wave on a staggered implicit global grid.
+
+Pressure + face velocities (`Vx` is `(nx+1, ny)` — a staggered array whose
+deeper halo the framework handles via the per-array overlap rule), all three
+fields exchanged in one grouped halo update per step.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg.models import wave2d
+
+
+def main(nx=128, ny=128, nt=500):
+    me, dims, nprocs, *_ = igg.init_global_grid(nx, ny, 1, periodx=1,
+                                                periody=1)
+    params = wave2d.Params()
+    (P, Vx, Vy), sec = wave2d.run(nt, params, dtype=np.float32)
+    G = igg.gather_interior(P)
+    if me == 0:
+        print(f"{nt} steps on {nprocs} device(s), dims {dims}: "
+              f"{sec * 1e3:.3f} ms/step; |P| in [{G.min():.4f}, {G.max():.4f}]")
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

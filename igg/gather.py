@@ -75,11 +75,16 @@ def _fetch_global(A) -> np.ndarray:
 
 
 def gather_interior(A, *, root: int = 0):
-    """Gather with overlap de-duplication: returns the true global field of
-    shape `(nx_g(A), ny_g(A), nz_g(A))` (what reference users assemble by
+    """Gather with overlap de-duplication (what reference users assemble by
     hand after stripping halos).  Block `c` contributes its cells
     `[0, s - ol)`; the last block of a non-periodic dimension also keeps its
-    trailing `ol` cells."""
+    trailing `ol` cells.
+
+    Shape contract per dimension: `nx_g(A)`-style size (`dims*(s-ol) +
+    ol*(period==0)` with the per-array staggered `ol`) for non-periodic
+    dims; for periodic dims the result holds the `dims*(s-ol)` *unique*
+    lattice cells — the wrap-around duplicate face of a staggered array is
+    not repeated, so there the size is one less than `nx_g(A)`."""
     shared.check_initialized()
     grid = shared.global_grid()
     if grid.me != root:

@@ -40,6 +40,19 @@ def _is_grid_leaf(x, grid) -> bool:
                for d in range(min(len(shape), NDIMS)))
 
 
+def _is_grid_local_shape(shape, grid) -> bool:
+    """Whether a *local* (per-device) output shape looks like a grid block:
+    each leading dim is within a stagger/flux margin of the local grid size
+    (covers `n`, `n±1`, halo-less `n-2`, larger overlaps).  Outputs that
+    don't (e.g. small diagnostics vectors) are treated as replicated rather
+    than silently concatenated into a wrong global array; pass explicit
+    `out_specs` to `sharded` for genuinely ambiguous shapes."""
+    if not shape:
+        return False
+    return all(abs(shape[d] - grid.nxyz[d]) <= max(grid.overlaps[d], 2)
+               for d in range(min(len(shape), NDIMS)))
+
+
 def _leaf_spec(x, grid):
     from jax.sharding import PartitionSpec as P
     if _is_grid_leaf(x, grid):
@@ -57,6 +70,27 @@ def _local_aval(x, grid):
         return jax.ShapeDtypeStruct(shape, x.dtype)
     arr = jnp.asarray(x) if not hasattr(x, "dtype") else x
     return jax.ShapeDtypeStruct(getattr(arr, "shape", ()), arr.dtype)
+
+
+def _fn_key(f):
+    """Cache key for a step function that survives closure re-creation: two
+    closures of the same code over equal (hashable) captured constants share
+    one compiled program, so `make_step(...)`-style factories don't re-trace
+    per call.  Falls back to identity for unhashable captures."""
+    code = getattr(f, "__code__", None)
+    if code is None:
+        return f
+    cells = ()
+    if getattr(f, "__closure__", None):
+        try:
+            cells = tuple(c.cell_contents for c in f.__closure__)
+        except ValueError:  # empty cell
+            return f
+    try:
+        hash(cells)
+    except TypeError:
+        return f
+    return (code, cells)
 
 
 _compiled: Dict[tuple, object] = {}
@@ -91,7 +125,7 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
             shared.check_initialized()
             grid = shared.global_grid()
             leaves, treedef = jax.tree.flatten(args)
-            key = (shared.grid_epoch(), f, treedef,
+            key = (shared.grid_epoch(), _fn_key(f), treedef,
                    tuple(donate_argnums), repr(out_specs),
                    tuple((getattr(x, "shape", ()),
                           str(getattr(x, "dtype", type(x)))) for x in leaves))
@@ -110,7 +144,9 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
                     _, out_aval = jax.make_jaxpr(
                         f, axis_env=axis_env, return_shape=True)(*local_avals)
                     o_specs = jax.tree.map(
-                        lambda a: spec_for(len(a.shape)) if a.shape else P(),
+                        lambda a: (spec_for(len(a.shape))
+                                   if _is_grid_local_shape(a.shape, grid)
+                                   else P()),
                         out_aval)
                 else:
                     o_specs = out_specs

@@ -1,0 +1,53 @@
+"""sharded() inference and caching regression tests."""
+
+import numpy as np
+
+import igg
+from igg import parallel
+
+
+def test_non_grid_output_is_replicated_not_concatenated():
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+    import jax.numpy as jnp
+
+    @igg.sharded
+    def step(T):
+        # small diagnostics vector: must come back replicated, not
+        # concatenated over gx into shape (24,)
+        return T + 1.0, jnp.zeros((3,)) + 7.0
+
+    T = igg.zeros((6, 6, 6))
+    T2, diag = step(T)
+    assert T2.shape == T.shape
+    assert diag.shape == (3,)
+    assert np.allclose(np.array(diag), 7.0)
+
+
+def test_staggered_and_flux_outputs_still_sharded():
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded
+    def step(T):
+        qx = T[1:, 1:-1, 1:-1] - T[:-1, 1:-1, 1:-1]   # (5,4,4) local
+        return qx
+
+    T = igg.zeros((6, 6, 6))
+    qx = step(T)
+    assert qx.shape == (2 * 5, 2 * 4, 2 * 4)
+
+
+def test_recreated_closures_share_compiled_program():
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+    from igg.models import diffusion3d as d3
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    n0 = len(parallel._compiled)
+    for _ in range(3):
+        step = d3.make_step(params, donate=False)  # fresh closure each time
+        T = step(T, Cp)
+    assert len(parallel._compiled) == n0 + 1  # one shared program
+
+
+def test_models_namespace_exports_wave2d():
+    import igg.models
+    assert hasattr(igg.models, "wave2d") and hasattr(igg.models, "diffusion3d")
