@@ -66,6 +66,7 @@ from .fields import (
     zeros,
 )
 from .parallel import local_coords, sharded
+from . import profiling
 
 __version__ = "0.1.0"
 
@@ -81,6 +82,6 @@ __all__ = [
     "tic", "toc", "barrier",
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
-    "local_coords", "sharded",
+    "local_coords", "sharded", "profiling",
     "__version__",
 ]

@@ -30,9 +30,7 @@ class Params:
     lz: float = 10.0
 
     def spacing(self) -> Tuple[float, float, float]:
-        return (self.lx / (igg.nx_g() - 1),
-                self.ly / (igg.ny_g() - 1),
-                self.lz / (igg.nz_g() - 1))
+        return igg.tools.spacing(self.lx, self.ly, self.lz)
 
     def timestep(self) -> float:
         dx, dy, dz = self.spacing()

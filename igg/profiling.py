@@ -1,0 +1,38 @@
+"""Profiling hooks.
+
+The reference's observability is `tic()/toc()` only (SURVEY §5,
+`/root/reference/src/tools.jl:228-234`); on TPU the idiomatic extra is an XLA
+profiler trace viewable in TensorBoard/Perfetto (per-op device timelines,
+collective overlap, HBM traffic).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/igg_trace"):
+    """Capture a device trace of the enclosed block:
+
+        with igg.profiling.trace("/tmp/trace"):
+            for _ in range(10):
+                T = step(T, Cp)
+
+    Open the result with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline (wraps
+    `jax.profiler.TraceAnnotation`)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -48,6 +48,13 @@ def nz_g(A=None) -> int:
     return g.nxyz_g[2] + ((s[2] if A.ndim > 2 else 1) - g.nxyz[2])
 
 
+def spacing(lx, ly, lz) -> Tuple[float, float, float]:
+    """(dx, dy, dz) for a domain of physical size (lx, ly, lz) spanned by the
+    global grid — the `l/(n_g-1)` convention of the reference examples
+    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:21-23`)."""
+    return (lx / (nx_g() - 1), ly / (ny_g() - 1), lz / (nz_g() - 1))
+
+
 # ---------------------------------------------------------------------------
 # Global coordinates (`/root/reference/src/tools.jl:100-109`)
 # ---------------------------------------------------------------------------
