@@ -14,7 +14,7 @@ from typing import Optional
 
 import numpy as np
 
-from . import shared
+from . import native, shared
 from .shared import GridError, NDIMS
 
 
@@ -57,7 +57,9 @@ def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
     if A_global.size != _nprocs_in(grid, A.ndim) * nlocal:
         raise GridError("The input argument A_global must be of length "
                         "nprocs*length(A)")
-    A_global[...] = out.reshape(A_global.shape)
+    src = out.reshape(A_global.shape)
+    if not native.memcopy(A_global, src):
+        A_global[...] = src
     return None
 
 
@@ -93,6 +95,20 @@ def gather_interior(A, *, root: int = 0):
 
     stacked = _fetch_global(A)
     local = grid.local_shape(A)
+
+    if A.ndim == 3:
+        # Hot path: one-pass threaded re-tile in the native runtime (the
+        # analog of the reference's re-tile loop + threaded host copies,
+        # `/root/reference/src/gather.jl:63-66`,
+        # `/root/reference/src/update_halo.jl:534-553`).
+        ols = [grid.ol_of_local(d, local) for d in range(3)]
+        out = native.retile(
+            np.ascontiguousarray(stacked), grid.dims, local,
+            keep=[local[d] - max(ols[d], 0) for d in range(3)],
+            full_last=[not grid.periods[d] for d in range(3)])
+        if out is not None:
+            return out
+
     out = stacked
     for d in range(min(A.ndim, NDIMS)):
         n = grid.dims[d]

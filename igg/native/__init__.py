@@ -1,0 +1,152 @@
+"""Native (C++) host-side runtime: threaded re-tile and memcopy.
+
+The reference's host-side copy machinery is effectively native code — SIMD
+(`memcopy_loopvect!`) and threaded (`memcopy_threads!`) copies
+(`/root/reference/src/update_halo.jl:534-563`) plus the gather re-tile loop
+(`/root/reference/src/gather.jl:63-66`).  This package holds the TPU build's
+equivalent: `retile.cpp`, compiled to a shared library and bound via ctypes.
+
+The library is compiled on demand with the system C++ compiler (cached next
+to the source, keyed by a source hash) or ahead of time with
+``python -m igg.native.build``.  Without a compiler, every entry point
+reports unavailable and callers fall back to their numpy paths; set
+``IGG_NATIVE=0`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "retile.cpp")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def _lib_path() -> str:
+    return os.path.join(_HERE, f"_iggnative_{_source_tag()}.so")
+
+
+def build(verbose: bool = False) -> str:
+    """Compile retile.cpp into the cached shared library; returns its path."""
+    path = _lib_path()
+    if os.path.exists(path):
+        return path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    if verbose:
+        print("[igg.native]", " ".join(cmd), file=sys.stderr)
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(tmp, path)  # atomic; concurrent builders each use their own tmp
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("IGG_NATIVE", "1") == "0":
+        return None
+    try:
+        lib = ctypes.CDLL(build())
+    except (OSError, subprocess.SubprocessError, FileNotFoundError):
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.igg_retile.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_int64, i64p, i64p, i64p, i64p,
+                               ctypes.c_int]
+    lib.igg_retile.restype = None
+    lib.igg_memcopy.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.c_int64, ctypes.c_int]
+    lib.igg_memcopy.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _nthreads() -> int:
+    env = os.environ.get("IGG_NATIVE_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return min(16, os.cpu_count() or 1)
+
+
+def _i64x3(vals) -> "ctypes.Array":
+    return (ctypes.c_int64 * 3)(*[int(v) for v in vals])
+
+
+def retile(stacked: np.ndarray, dims, s, keep, full_last) -> Optional[np.ndarray]:
+    """De-duplicate a 3-D block-stacked array: block (c0,c1,c2) of shape `s`
+    contributes cells `[0, keep_d)` per dim (the full `s_d` for the last
+    block of a dim with `full_last[d]`), written at offset `c*keep`.
+
+    Returns the assembled array, or None when the native library is
+    unavailable or the input doesn't qualify (caller falls back to numpy).
+    """
+    lib = _load()
+    if lib is None or stacked.ndim != 3 or not stacked.flags.c_contiguous:
+        return None
+    if stacked.dtype.hasobject:
+        return None
+    dims = [int(v) for v in dims]
+    s = [int(v) for v in s]
+    keep = [int(v) for v in keep]
+    full_last = [1 if v else 0 for v in full_last]
+    if stacked.shape != tuple(d * ss for d, ss in zip(dims, s)):
+        return None
+    if any(k < 0 or k > ss for k, ss in zip(keep, s)):
+        return None
+    out_shape = tuple((d - 1) * k + (ss if fl else k)
+                      for d, k, ss, fl in zip(dims, keep, s, full_last))
+    if any(v <= 0 for v in out_shape):
+        return None
+    out = np.empty(out_shape, dtype=stacked.dtype)
+    lib.igg_retile(
+        stacked.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p),
+        ctypes.c_int64(stacked.dtype.itemsize),
+        _i64x3(dims), _i64x3(s), _i64x3(keep), _i64x3(full_last),
+        ctypes.c_int(_nthreads()))
+    return out
+
+
+def memcopy(dst: np.ndarray, src: np.ndarray) -> bool:
+    """Threaded flat copy of `src` into `dst` (same total byte size, both
+    C-contiguous).  Returns False when the native path doesn't apply —
+    caller falls back to numpy assignment."""
+    lib = _load()
+    if (lib is None or not dst.flags.c_contiguous or not dst.flags.writeable
+            or not src.flags.c_contiguous or dst.nbytes != src.nbytes
+            or dst.dtype != src.dtype or dst.dtype.hasobject):
+        return False
+    lib.igg_memcopy(dst.ctypes.data_as(ctypes.c_char_p),
+                    src.ctypes.data_as(ctypes.c_char_p),
+                    ctypes.c_int64(dst.nbytes), ctypes.c_int(_nthreads()))
+    return True

@@ -1,0 +1,7 @@
+"""Ahead-of-time build of the igg native library: ``python -m igg.native.build``."""
+
+from . import available, build
+
+if __name__ == "__main__":
+    print(build(verbose=True))
+    assert available()

@@ -1,0 +1,132 @@
+// igg native runtime: threaded host-side block re-tile and memcopy.
+//
+// TPU-native counterpart of the reference's host-side copy machinery: the
+// gather re-tile loop (`/root/reference/src/gather.jl:63-66`) and the
+// threaded/SIMD `memcopy_threads!`/`memcopy_loopvect!` host copies
+// (`/root/reference/src/update_halo.jl:534-563`).  On TPU the halo path never
+// touches the host, so the only host-side hot path left is the
+// gather-for-visualization assembly: de-duplicating the overlap cells of a
+// block-stacked global array fetched from device HBM into one dense array.
+// numpy expresses that as take+concatenate chains (one temporary per
+// dimension); this does it as one pass of parallel row memcpys.
+//
+// Layout contract (C order throughout):
+//   src: the stacked array, shape (dims0*s0, dims1*s1, dims2*s2) * esize bytes;
+//        block (c0,c1,c2) occupies the slab [c0*s0:(c0+1)*s0) x ... — the
+//        Cartesian tiling `cart_gather!` produces in the reference.
+//   dst: shape out_d = (dims_d-1)*keep_d + (full_last_d ? s_d : keep_d).
+//   Block (c0,c1,c2) contributes its cells [0, e_d) per dim, where
+//   e_d = (c_d == dims_d-1 && full_last_d) ? s_d : keep_d, written at dst
+//   offset c_d*keep_d — the overlap-trimming rule of `gather_interior`.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Retile {
+  const char* src;
+  char* dst;
+  int64_t esize;
+  int64_t dims[3], s[3], keep[3], full_last[3];
+  int64_t e_of(int64_t c, int d) const {
+    return (c == dims[d] - 1 && full_last[d]) ? s[d] : keep[d];
+  }
+};
+
+// Copy every row (contiguous innermost run) of one block.
+void copy_block(const Retile& r, int64_t c0, int64_t c1, int64_t c2,
+                int64_t i0_begin, int64_t i0_end) {
+  const int64_t S1 = r.dims[1] * r.s[1], S2 = r.dims[2] * r.s[2];
+  const int64_t out1 = (r.dims[1] - 1) * r.keep[1] +
+                       (r.full_last[1] ? r.s[1] : r.keep[1]);
+  const int64_t out2 = (r.dims[2] - 1) * r.keep[2] +
+                       (r.full_last[2] ? r.s[2] : r.keep[2]);
+  const int64_t e1 = r.e_of(c1, 1), e2 = r.e_of(c2, 2);
+  const int64_t row_bytes = e2 * r.esize;
+  for (int64_t i0 = i0_begin; i0 < i0_end; ++i0) {
+    const char* sp0 = r.src + ((c0 * r.s[0] + i0) * S1 * S2) * r.esize;
+    char* dp0 = r.dst + ((c0 * r.keep[0] + i0) * out1 * out2) * r.esize;
+    for (int64_t i1 = 0; i1 < e1; ++i1) {
+      const char* sp = sp0 + ((c1 * r.s[1] + i1) * S2 + c2 * r.s[2]) * r.esize;
+      char* dp = dp0 + ((c1 * r.keep[1] + i1) * out2 + c2 * r.keep[2]) * r.esize;
+      std::memcpy(dp, sp, static_cast<size_t>(row_bytes));
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Re-tile the stacked array into the de-duplicated global array.  Work is
+// sliced over (block, x-row-chunk) tasks and pulled off an atomic counter by
+// `nthreads` workers (the structural analog of the reference's
+// `@threads`-chunked `memcopy_threads!`, update_halo.jl:534-553).
+void igg_retile(const char* src, char* dst, int64_t esize,
+                const int64_t* dims, const int64_t* s, const int64_t* keep,
+                const int64_t* full_last, int nthreads) {
+  Retile r{src, dst, esize, {}, {}, {}, {}};
+  for (int d = 0; d < 3; ++d) {
+    r.dims[d] = dims[d];
+    r.s[d] = s[d];
+    r.keep[d] = keep[d];
+    r.full_last[d] = full_last[d];
+  }
+  struct Task { int64_t c0, c1, c2, i0_begin, i0_end; };
+  std::vector<Task> tasks;
+  const int64_t chunk = 16;  // x-rows per task: enough tasks to balance
+  for (int64_t c0 = 0; c0 < r.dims[0]; ++c0) {
+    const int64_t e0 = r.e_of(c0, 0);
+    for (int64_t c1 = 0; c1 < r.dims[1]; ++c1)
+      for (int64_t c2 = 0; c2 < r.dims[2]; ++c2)
+        for (int64_t i0 = 0; i0 < e0; i0 += chunk)
+          tasks.push_back({c0, c1, c2, i0, std::min(i0 + chunk, e0)});
+  }
+  int nt = std::max(1, std::min<int>(nthreads, static_cast<int>(tasks.size())));
+  if (nt == 1) {
+    for (const Task& t : tasks)
+      copy_block(r, t.c0, t.c1, t.c2, t.i0_begin, t.i0_end);
+    return;
+  }
+  std::atomic<size_t> next{0};
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (int w = 0; w < nt; ++w)
+    workers.emplace_back([&] {
+      for (size_t i; (i = next.fetch_add(1)) < tasks.size();) {
+        const Task& t = tasks[i];
+        copy_block(r, t.c0, t.c1, t.c2, t.i0_begin, t.i0_end);
+      }
+    });
+  for (auto& w : workers) w.join();
+}
+
+// Plain parallel memcopy (threaded, chunked) for large host buffer moves —
+// e.g. filling a caller-provided A_global in `gather`.
+void igg_memcopy(char* dst, const char* src, int64_t nbytes, int nthreads) {
+  const int64_t min_chunk = 1 << 20;  // below ~1 MiB threads cost more than they save
+  int nt = static_cast<int>(std::min<int64_t>(
+      std::max(1, nthreads), std::max<int64_t>(1, nbytes / min_chunk)));
+  if (nt <= 1) {
+    std::memcpy(dst, src, static_cast<size_t>(nbytes));
+    return;
+  }
+  const int64_t chunk = (nbytes + nt - 1) / nt;
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (int w = 0; w < nt; ++w) {
+    const int64_t b = w * chunk, e = std::min(nbytes, b + chunk);
+    if (b >= e) break;
+    workers.emplace_back([dst, src, b, e] {
+      std::memcpy(dst + b, src + b, static_cast<size_t>(e - b));
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"
