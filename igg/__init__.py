@@ -65,6 +65,7 @@ from .fields import (
     stacked_shape,
     zeros,
 )
+from .overlap import hide_communication
 from .parallel import local_coords, sharded
 from . import profiling
 
@@ -82,6 +83,6 @@ __all__ = [
     "tic", "toc", "barrier",
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
-    "local_coords", "sharded", "profiling",
+    "hide_communication", "local_coords", "sharded", "profiling",
     "__version__",
 ]

@@ -94,46 +94,52 @@ def check_fields(grid, fields, local_shapes) -> None:
 # The exchange itself (operates on per-device local blocks)
 # ---------------------------------------------------------------------------
 
-def _exchange_dim(A, d: int, ol: int, n: int, periodic: bool):
-    """Exchange the two boundary planes of local block `A` along array/grid
-    dimension `d` with the neighboring devices on mesh axis AXIS_NAMES[d]."""
+def exchange_planes(left_send, right_send, stale_first, stale_last,
+                    d: int, n: int, periodic: bool):
+    """Plane-level neighbor shift along mesh axis `d`: returns the
+    (new_first, new_last) halo planes of the local block.
+
+    Open-boundary edge devices receive zeros from the (non-wrapping) permute;
+    the stale planes are returned there instead — the reference's no-write
+    semantics (`/root/reference/test/test_update_halo.jl:727-732`).  With one
+    device along the axis, periodic exchange degenerates to a pure local copy
+    (self-neighbor path, `/root/reference/src/update_halo.jl:516-532`).
+    """
     import jax.numpy as jnp
     from jax import lax
 
-    s = A.shape[d]
     axis = AXIS_NAMES[d]
-
-    # Packed planes (always from the pre-exchange A, like the reference packs
-    # all sendbufs before any receive, `/root/reference/src/update_halo.jl:37-39`).
-    left_send = lax.slice_in_dim(A, ol - 1, ol, axis=d)        # to left nb's last plane
-    right_send = lax.slice_in_dim(A, s - ol, s - ol + 1, axis=d)  # to right nb's first plane
-
     if n == 1:
         if not periodic:
-            return A
-        # Self-neighbor path (`/root/reference/src/update_halo.jl:516-532`):
-        # pure local plane copies, no collective.
-        A = lax.dynamic_update_slice_in_dim(A, left_send, s - 1, axis=d)
-        A = lax.dynamic_update_slice_in_dim(A, right_send, 0, axis=d)
-        return A
+            return stale_first, stale_last
+        return right_send, left_send
 
     shift_down = [(i, i - 1) for i in range(1, n)] + ([(0, n - 1)] if periodic else [])
     shift_up = [(i, i + 1) for i in range(n - 1)] + ([(n - 1, 0)] if periodic else [])
     from_right = lax.ppermute(left_send, axis, shift_down)   # right nb's inner plane
     from_left = lax.ppermute(right_send, axis, shift_up)     # left nb's inner plane
-
     if periodic:
-        new_last, new_first = from_right, from_left
-    else:
-        # Edge devices received zeros from the (non-wrapping) permute; keep
-        # their stale halo instead — open-boundary no-write semantics
-        # (`/root/reference/test/test_update_halo.jl:727-732`).
-        idx = lax.axis_index(axis)
-        new_last = jnp.where(idx < n - 1, from_right,
-                             lax.slice_in_dim(A, s - 1, s, axis=d))
-        new_first = jnp.where(idx > 0, from_left,
-                              lax.slice_in_dim(A, 0, 1, axis=d))
+        return from_left, from_right
+    idx = lax.axis_index(axis)
+    return (jnp.where(idx > 0, from_left, stale_first),
+            jnp.where(idx < n - 1, from_right, stale_last))
 
+
+def _exchange_dim(A, d: int, ol: int, n: int, periodic: bool):
+    """Exchange the two boundary planes of local block `A` along array/grid
+    dimension `d` with the neighboring devices on mesh axis AXIS_NAMES[d]."""
+    from jax import lax
+
+    s = A.shape[d]
+    # Packed planes (always from the pre-exchange A, like the reference packs
+    # all sendbufs before any receive, `/root/reference/src/update_halo.jl:37-39`).
+    left_send = lax.slice_in_dim(A, ol - 1, ol, axis=d)        # to left nb's last plane
+    right_send = lax.slice_in_dim(A, s - ol, s - ol + 1, axis=d)  # to right nb's first plane
+
+    new_first, new_last = exchange_planes(
+        left_send, right_send,
+        lax.slice_in_dim(A, 0, 1, axis=d), lax.slice_in_dim(A, s - 1, s, axis=d),
+        d, n, periodic)
     A = lax.dynamic_update_slice_in_dim(A, new_last, s - 1, axis=d)
     A = lax.dynamic_update_slice_in_dim(A, new_first, 0, axis=d)
     return A

@@ -65,10 +65,12 @@ def init_fields(params: Params = Params(), dtype=np.float32):
     return T, Cp
 
 
-def local_step(T, Cp, *, dx, dy, dz, dt, lam):
-    """One diffusion step over per-device local arrays (the user-model of the
-    reference: physics written for a single device's block,
-    `/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`)."""
+def compute_step(T, Cp, *, dx, dy, dz, dt, lam):
+    """The pure stencil update (no halo exchange): Fourier-law fluxes on the
+    staggered inner faces + conservative interior temperature update
+    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`).
+    Shift-invariant and radius-1, so it is usable both full-domain and on the
+    boundary slabs of :func:`igg.hide_communication`."""
     # Fourier's law on the staggered inner faces: q = -λ ∂T
     qx = -lam * (T[1:, 1:-1, 1:-1] - T[:-1, 1:-1, 1:-1]) / dx
     qy = -lam * (T[1:-1, 1:, 1:-1] - T[1:-1, :-1, 1:-1]) / dy
@@ -78,8 +80,21 @@ def local_step(T, Cp, *, dx, dy, dz, dt, lam):
         -(qx[1:, :, :] - qx[:-1, :, :]) / dx
         - (qy[:, 1:, :] - qy[:, :-1, :]) / dy
         - (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
-    T = T.at[1:-1, 1:-1, 1:-1].add(dt * dTdt)
-    return igg.update_halo_local(T)
+    return T.at[1:-1, 1:-1, 1:-1].add(dt * dTdt)
+
+
+def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False):
+    """One diffusion step over per-device local arrays (the user-model of the
+    reference: physics written for a single device's block).  With
+    `overlap=True` the step is restructured by :func:`igg.hide_communication`
+    so the halo collectives are data-independent of the full-domain stencil
+    and XLA can overlap them (ParallelStencil's `@hide_communication`,
+    `/root/reference/README.md:9`)."""
+    kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, lam=lam)
+    if overlap:
+        return igg.hide_communication(
+            T, lambda Tb, Cpb: compute_step(Tb, Cpb, **kw), Cp)
+    return igg.update_halo_local(compute_step(T, Cp, **kw))
 
 
 def _pallas_applicable(use_pallas, T) -> bool:
@@ -106,19 +121,22 @@ def _best_bx(S0: int) -> int:
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
-              use_pallas="auto"):
+              use_pallas="auto", overlap: bool = False):
     """Compiled whole-step function `(T, Cp) -> T` over the grid mesh.
 
     `use_pallas`: "auto" (default) uses the fused Pallas kernel
     (`igg.ops.fused_diffusion_step`) when it applies (single TPU device,
     fully-periodic overlap-2 grid, f32); False forces the portable
     shard_map/XLA path; True requires the kernel and raises if inapplicable.
+    `overlap`: restructure each step with `igg.hide_communication`.
     """
-    return make_multi_step(1, params, donate=donate, use_pallas=use_pallas)
+    return make_multi_step(1, params, donate=donate, use_pallas=use_pallas,
+                           overlap=overlap)
 
 
 def make_multi_step(n_inner: int, params: Params = Params(), *,
-                    donate: bool = True, use_pallas="auto"):
+                    donate: bool = True, use_pallas="auto",
+                    overlap: bool = False):
     """Compiled `(T, Cp) -> T` advancing `n_inner` steps in ONE XLA program
     (`lax.fori_loop` around the step, halo ppermutes included).  This is the
     TPU-idiomatic time loop: host dispatch overhead amortizes to zero, and
@@ -135,14 +153,23 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
         return lax.fori_loop(
             0, n_inner,
             lambda _, T: local_step(T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
-                                    lam=params.lam),
+                                    lam=params.lam, overlap=overlap),
             T)
+
+    if overlap and use_pallas is True:
+        raise igg.GridError(
+            "overlap=True applies to the shard_map/XLA path only (the fused "
+            "Pallas kernel is single-device: there is no communication to "
+            "hide); pass use_pallas=False or 'auto'.")
 
     xla_path = igg.sharded(steps, donate_argnums=(0,) if donate else ())
     cache = {}
 
     def dispatch(T, Cp):
-        if _pallas_applicable(use_pallas, T):
+        # overlap=True forces the shard_map/XLA path so the restructured
+        # step is what actually runs (the Pallas kernel only applies on a
+        # single device, where there are no collectives to overlap anyway).
+        if not overlap and _pallas_applicable(use_pallas, T):
             from igg.ops import fused_diffusion_step
             key = (T.shape, str(T.dtype))
             fn = cache.get(key)
@@ -164,13 +191,15 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,
-        warmup: int = 1, n_inner: int = 1, use_pallas="auto"):
+        warmup: int = 1, n_inner: int = 1, use_pallas="auto",
+        overlap: bool = False):
     """Run `nt * n_inner` timed steps after exactly `warmup` untimed
     dispatches (warmup=0 includes compilation in the timing); with
     `n_inner > 1` each dispatch advances `n_inner` steps inside one compiled
     program.  Returns (T, seconds_per_step)."""
     T, Cp = init_fields(params, dtype=dtype)
-    step = make_multi_step(n_inner, params, use_pallas=use_pallas)
+    step = make_multi_step(n_inner, params, use_pallas=use_pallas,
+                           overlap=overlap)
     for _ in range(warmup):
         T = step(T, Cp)
     igg.tic()

@@ -1,0 +1,132 @@
+"""Communication/computation overlap: the TPU-native `hide_communication`.
+
+The reference delegates comm/compute overlap to the caller: it provides
+max-priority copy streams so an application layer (ParallelStencil's
+`@hide_communication`) can compute the domain interior while `update_halo!`
+messages are in flight (`/root/reference/README.md:9`,
+`/root/reference/src/update_halo.jl:337,365`).
+
+On TPU the equivalent is *structural*: inside one XLA program, a
+collective-permute can overlap with compute only if there is no data
+dependency between them.  In the naive step
+
+    A' = compute(A); A' = update_halo_local(A')
+
+the ppermutes consume planes of `A'`, so the whole stencil update must finish
+before the first flit leaves the chip.  :func:`hide_communication`
+restructures the step: the send planes are produced by thin, redundant *slab*
+computations (two `(1+2r)`-plane stencil applications per dimension), the
+dimension-sequential plane-level exchange runs on those — corner/edge
+propagation intact — and the full-domain `compute(A)` is data-independent of
+the entire exchange chain, so XLA's latency-hiding scheduler can run it while
+the collectives ride the ICI links.  Cost: recomputing ~6 boundary planes,
+O(s²) work against the O(s³) interior — the same trade ParallelStencil makes.
+
+Semantics vs the sequential composition:
+  - fully periodic or interior ranks: identical (the exchanged planes are the
+    same arithmetic on the same values);
+  - open-boundary edge ranks: halo planes keep their *pre-compute* values
+    (the reference's no-write semantics — its users' stencils never write
+    halo planes, `/root/reference/test/test_update_halo.jl:727-732`), whereas
+    the plain composition leaves whatever `compute` put there.  Halo cells at
+    an open boundary are not meaningful in either model.
+
+Requirements on `compute`: a shift-invariant local stencil of radius
+`<= ol-1` per participating dimension (it is applied to thin slabs, so it
+must accept any extent along the grid dimensions — `jnp.roll`/shift-based
+stencils do).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+from . import shared
+from .halo import exchange_planes
+from .shared import NDIMS, GridError
+
+
+def _plane(A, d: int, i: int):
+    from jax import lax
+    return lax.slice_in_dim(A, i, i + 1, axis=d)
+
+
+def _put_plane(A, P, d: int, i: int):
+    from jax import lax
+    return lax.dynamic_update_slice_in_dim(A, P, i, axis=d)
+
+
+def hide_communication(A, compute: Callable, *aux, radius: int = 1):
+    """`update_halo_local(compute(A, *aux))`, restructured so the halo
+    exchange is data-independent of the full-domain compute (see module
+    docstring).
+
+    For use *inside* SPMD code (`igg.sharded` functions / shard_map), exactly
+    like :func:`igg.update_halo_local`; `A` is the per-device local block.
+    `aux` are read-only coefficient fields of the stencil (e.g. the heat
+    capacity in the diffusion model); they must have the same local shape as
+    `A` so they can be sliced into the same boundary slabs.  Returns the
+    updated block.
+    """
+    from jax import lax
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    s = A.shape
+    for i, B in enumerate(aux):
+        if B.shape != s:
+            raise GridError(
+                f"hide_communication: aux field {i} has shape {B.shape} != "
+                f"{s}; aux fields must match the primary field's local shape "
+                f"(pre-slice staggered coefficients inside `compute`).")
+
+    dims_active = []
+    for d in range(min(A.ndim, NDIMS)):
+        ol = grid.ol_of_local(d, s)
+        if ol < 2:
+            continue
+        if radius > ol - 1:
+            raise GridError(
+                f"hide_communication: stencil radius {radius} exceeds ol-1="
+                f"{ol - 1} along dimension {d}; the send planes cannot be "
+                f"computed from in-block data.")
+        dims_active.append((d, ol))
+
+    # 1. Send planes from thin slab computations (independent of the full
+    #    compute).  Slab [p-r, p+r] around send plane p; its center plane has
+    #    all its stencil inputs inside the slab.
+    send: Dict[Tuple[int, int], object] = {}
+    for d, ol in dims_active:
+        for side, p in ((0, ol - 1), (1, s[d] - ol)):
+            cut = lambda B: lax.slice_in_dim(B, p - radius, p + radius + 1,
+                                             axis=d)
+            send[(d, side)] = _plane(compute(cut(A), *map(cut, aux)),
+                                     d, radius)
+
+    # 2. Dimension-sequential plane-level exchange.  After dim d's exchange,
+    #    the *pending* send planes of later dimensions get their dim-d edge
+    #    rows overwritten with the received/stale halo rows — the plane-level
+    #    form of the reference's corner propagation
+    #    (`/root/reference/src/update_halo.jl:130`).
+    recv: Dict[Tuple[int, int], object] = {}
+    for i, (d, ol) in enumerate(dims_active):
+        new_first, new_last = exchange_planes(
+            send[(d, 0)], send[(d, 1)], _plane(A, d, 0), _plane(A, d, s[d] - 1),
+            d, grid.dims[d], bool(grid.periods[d]))
+        recv[(d, 0)], recv[(d, 1)] = new_first, new_last
+        for d2, ol2 in dims_active[i + 1:]:
+            for side2, p2 in ((0, ol2 - 1), (1, s[d2] - ol2)):
+                P = send[(d2, side2)]
+                P = _put_plane(P, _plane(new_first, d2, p2), d, 0)
+                P = _put_plane(P, _plane(new_last, d2, p2), d, s[d] - 1)
+                send[(d2, side2)] = P
+
+    # 3. Full-domain compute — no data dependency on any ppermute above.
+    out = compute(A, *aux)
+
+    # 4. Assembly, in dimension order (later writes own the corner cells,
+    #    like the reference's later exchanges).
+    for d, ol in dims_active:
+        out = _put_plane(out, recv[(d, 0)], d, 0)
+        out = _put_plane(out, recv[(d, 1)], d, s[d] - 1)
+    return out

@@ -1,0 +1,158 @@
+"""hide_communication: overlap-restructured step vs the plain composition.
+
+The contract (igg/overlap.py): for fully-periodic grids and on interior
+ranks the result is identical to `update_halo_local(compute(A))`; at open
+boundaries the halo planes keep their pre-compute values (the reference's
+no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import igg
+
+
+def stencil(A):
+    """Radius-1 shift-invariant stencil (roll-based, accepts any extent)."""
+    out = 0.1 * A
+    for d in range(A.ndim):
+        out = out + 0.15 * (jnp.roll(A, 1, axis=d) + jnp.roll(A, -1, axis=d))
+    return out
+
+
+def coord_filled(shape, dx=1.0):
+    A = igg.zeros(shape)
+    X, Y, Z = igg.coord_fields(dx, dx, dx, A)
+    return A + X * 10000 + Y * 100 + Z + 0.5
+
+
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0), (1, 0, 1)])
+def test_matches_composition(eight_devices, periods):
+    igg.init_global_grid(6, 6, 6, periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    A0 = coord_filled((6, 6, 6))
+
+    @igg.sharded
+    def step_plain(A):
+        return igg.update_halo_local(stencil(A))
+
+    @igg.sharded
+    def step_overlap(A):
+        return igg.hide_communication(A, stencil)
+
+    plain = np.asarray(step_plain(A0))
+    over = np.asarray(step_overlap(A0))
+    grid = igg.get_global_grid()
+    s = grid.local_shape(A0)
+
+    # Build a mask of cells where the two formulations are specified to agree:
+    # everywhere except the halo planes of open-boundary edge blocks.
+    agree = np.ones(A0.shape, bool)
+    for d in range(3):
+        if grid.periods[d]:
+            continue
+        n, sd = grid.dims[d], s[d]
+        first = np.arange(A0.shape[d]) == 0               # block 0, plane 0
+        last = np.arange(A0.shape[d]) == n * sd - 1        # last block, plane s-1
+        shape_d = [1, 1, 1]
+        shape_d[d] = A0.shape[d]
+        agree &= ~(first | last).reshape(shape_d)
+    np.testing.assert_allclose(plain[agree], over[agree],
+                               rtol=1e-12, atol=1e-9)
+
+    # Open-boundary halo planes: overlapped form keeps the pre-compute values.
+    A0np = np.asarray(A0)
+    np.testing.assert_array_equal(over[~agree], A0np[~agree])
+    igg.finalize_global_grid()
+
+
+def test_multiple_steps_periodic_exact(eight_devices):
+    """Overlapped and plain steps agree to FP tolerance over many steps on a
+    fully periodic grid (the halo cells feed back into the stencil; the
+    two program shapes may fuse/FMA-contract differently, so equality is
+    numerical rather than bitwise)."""
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+    A = B = coord_filled((6, 6, 6))
+
+    @igg.sharded
+    def step_plain(A):
+        return igg.update_halo_local(stencil(A))
+
+    @igg.sharded
+    def step_overlap(A):
+        return igg.hide_communication(A, stencil)
+
+    for _ in range(5):
+        A = step_plain(A)
+        B = step_overlap(B)
+    np.testing.assert_allclose(np.asarray(A), np.asarray(B),
+                               rtol=1e-12, atol=1e-9)
+    igg.finalize_global_grid()
+
+
+def test_staggered_and_2d(eight_devices):
+    """Staggered field (nx+1) and a 2-D field go through the same contract."""
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+    Vx = coord_filled((7, 6, 6))
+
+    @igg.sharded
+    def step_plain(A):
+        return igg.update_halo_local(stencil(A))
+
+    @igg.sharded
+    def step_overlap(A):
+        return igg.hide_communication(A, stencil)
+
+    np.testing.assert_allclose(np.asarray(step_plain(Vx)),
+                               np.asarray(step_overlap(Vx)),
+                               rtol=1e-12, atol=1e-9)
+    igg.finalize_global_grid()
+
+
+def test_radius_too_large_raises(eight_devices):
+    igg.init_global_grid(8, 8, 8, periodx=1, periody=1, periodz=1, quiet=True)
+    A = igg.zeros((8, 8, 8))
+    with pytest.raises(igg.GridError, match="radius"):
+        @igg.sharded
+        def step(A):
+            return igg.hide_communication(A, stencil, radius=2)
+        step(A)
+    igg.finalize_global_grid()
+
+
+def test_diffusion_model_overlap_matches(eight_devices):
+    """The flagship model run with overlap=True agrees with the plain path."""
+    from igg.models import diffusion3d as d3
+    igg.init_global_grid(8, 8, 8, periodx=1, periody=1, periodz=1, quiet=True)
+    p = d3.Params()
+    T0, Cp = d3.init_fields(p, dtype=np.float64)
+    plain = d3.make_multi_step(3, p, donate=False, use_pallas=False)
+    over = d3.make_multi_step(3, p, donate=False, use_pallas=False,
+                              overlap=True)
+    np.testing.assert_allclose(np.asarray(plain(T0, Cp)),
+                               np.asarray(over(T0, Cp)),
+                               rtol=1e-12, atol=1e-12)
+    igg.finalize_global_grid()
+
+
+def test_self_neighbor_axis(eight_devices):
+    """A periodic dimension with one device along it takes the plane-level
+    self-neighbor local-copy path inside hide_communication (the analog of
+    `/root/reference/src/update_halo.jl:516-532`)."""
+    igg.init_global_grid(6, 6, 6, dimx=4, dimy=1, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    A0 = coord_filled((6, 6, 6))
+
+    @igg.sharded
+    def step_plain(A):
+        return igg.update_halo_local(stencil(A))
+
+    @igg.sharded
+    def step_overlap(A):
+        return igg.hide_communication(A, stencil)
+
+    np.testing.assert_allclose(np.asarray(step_plain(A0)),
+                               np.asarray(step_overlap(A0)),
+                               rtol=1e-12, atol=1e-9)
+    igg.finalize_global_grid()
