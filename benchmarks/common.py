@@ -1,0 +1,60 @@
+"""Shared benchmark machinery.
+
+Timing convention: the unit of dispatch is ONE jitted program that advances
+`n_inner` iterations via `lax.fori_loop` — per-call host/tunnel dispatch
+latency (ms-scale on remote TPU runtimes) amortizes to zero, which is the
+TPU-idiomatic way to run a time loop (cf. `igg.models.diffusion3d.make_multi_step`).
+Timings use the grid's barrier-synchronized chronometer (`igg.tic`/`igg.toc`),
+the counterpart of the reference's MPI-barrier timers
+(`/root/reference/src/tools.jl:228-234`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# Virtual host devices (`--xla_force_host_platform_device_count`) only exist
+# on the CPU backend, and this image force-registers a TPU plugin that
+# otherwise wins backend selection — pin CPU before any backend initializes
+# (same reasoning as `__graft_entry__.py`).
+if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def emit(record: dict, stream=sys.stdout) -> None:
+    """One JSON line per result (the contract of the repo's `bench.py`)."""
+    print(json.dumps(record), file=stream)
+    stream.flush()
+
+
+def note(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def time_dispatches(fn, args, *, nt: int, warmup: int = 1):
+    """Seconds per dispatch of `fn(*args)`: `warmup` untimed calls (compile +
+    cache warm), then `nt` timed calls between `tic()` and `toc()`.
+
+    `fn` must be side-effect-free w.r.t. `args` (no donation), so repeated
+    calls are valid.
+    """
+    import jax
+
+    import igg
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    igg.tic()
+    for _ in range(nt):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    elapsed = igg.toc()
+    return elapsed / nt

@@ -1,0 +1,91 @@
+"""Effective halo-exchange bandwidth per chip — the BASELINE.json headline
+metric ("GB/s effective halo-exchange bandwidth per chip").
+
+Measures `update_halo` (the whole engine: pack slices -> ppermute/self-wrap ->
+unpack dynamic-update-slices, dimension-sequential) on a fully-periodic grid,
+for 1..N fields at once, amortized inside one XLA program per measurement.
+
+Accounting (stated so numbers are comparable across runs): per field and per
+participating dimension, every chip sends 2 boundary planes and receives 2 —
+`bytes_moved = fields * dims_active * 4 * plane_bytes`.  On a single chip the
+periodic exchange is the self-wrap path (pure HBM copies, the analog of the
+reference's self-neighbor branch `/root/reference/src/update_halo.jl:516-532`);
+on a multi-chip mesh the planes ride the ICI links.
+
+Usage: `python benchmarks/halo_bandwidth.py [n] [nt] [n_inner]`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import emit, note, time_dispatches
+
+
+def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
+    import jax
+    from jax import lax
+
+    import igg
+
+    grid = igg.get_global_grid()
+    fields = tuple(igg.zeros((n, n, n), dtype=dtype) + i
+                   for i in range(nfields))
+    spec = igg.spec_for(3)
+
+    def body(*fs):
+        def it(_, fs):
+            out = igg.update_halo_local(*fs)
+            return out if isinstance(out, tuple) else (out,)
+        return lax.fori_loop(0, n_inner, it, fs)
+
+    fn = jax.jit(jax.shard_map(body, mesh=grid.mesh,
+                               in_specs=(spec,) * nfields,
+                               out_specs=(spec,) * nfields))
+    sec = time_dispatches(fn, fields, nt=nt) / n_inner
+
+    itemsize = np.dtype(dtype).itemsize
+    plane_bytes = n * n * itemsize
+    bytes_moved = nfields * 3 * 4 * plane_bytes  # 3 dims, 2 sides, send+recv
+    return sec, bytes_moved / sec / 1e9
+
+
+def main():
+    import jax
+
+    import igg
+
+    platform = jax.devices()[0].platform
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform != "cpu" else 64)
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (50 if platform != "cpu" else 10)
+
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} "
+         f"local={n}^3 n_inner={n_inner}")
+
+    import jax.numpy as jnp
+
+    # f16 on CPU (f64 needs jax_enable_x64); bf16 on accelerators.
+    dtypes = (np.float32, np.float16 if platform == "cpu" else jnp.bfloat16)
+    for nfields in (1, 2, 4):
+        for dtype in dtypes:
+            sec, gbps = bench(n, nfields, dtype, nt=nt, n_inner=n_inner)
+            emit({
+                "metric": "halo_exchange_bandwidth_per_chip",
+                "value": round(gbps, 2),
+                "unit": "GB/s",
+                "config": {"local": n, "fields": nfields,
+                           "dtype": np.dtype(dtype).name,
+                           "devices": grid.nprocs, "dims": list(grid.dims),
+                           "platform": platform},
+                "us_per_update": round(sec * 1e6, 2),
+            })
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Comm/compute overlap study (BASELINE.json config 5: "comm/compute overlap
+(@hide_communication)").
+
+Times the diffusion step three ways on the same grid:
+  1. plain      — compute then `update_halo_local` (XLA may still overlap
+                  what the data flow allows);
+  2. hidden     — `igg.hide_communication`: send planes from thin slab
+                  recomputations, so the full-domain stencil is
+                  data-independent of every collective;
+  3. pallas     — the fused single-device kernel, where applicable (upper
+                  bound: no exchange, halo maintained in-kernel).
+
+On a 1-device grid the exchange is HBM-local, so 1 vs 2 bounds the overhead of
+the restructuring itself; on a real multi-chip mesh the difference is hidden
+ICI latency.
+
+Usage: `python benchmarks/overlap_study.py [local_n] [nt] [n_inner]`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import emit, note
+
+
+def main():
+    import jax
+
+    import igg
+    from igg.models import diffusion3d as d3
+
+    platform = jax.devices()[0].platform
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform != "cpu" else 32)
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (50 if platform != "cpu" else 5)
+
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} local={n}^3")
+
+    variants = [("plain", dict(use_pallas=False, overlap=False)),
+                ("hidden", dict(use_pallas=False, overlap=True))]
+    from igg.ops import pallas_supported
+    T0 = igg.zeros((n, n, n), dtype=np.float32)
+    if platform == "tpu" and pallas_supported(grid, T0):
+        variants.append(("pallas", dict(use_pallas=True, overlap=False)))
+
+    times = {}
+    for name, kw in variants:
+        _, sec = d3.run(nt, dtype=np.float32, n_inner=n_inner, **kw)
+        times[name] = sec
+        emit({
+            "metric": f"diffusion3d_step_{name}",
+            "value": round(sec * 1e3, 4),
+            "unit": "ms",
+            "config": {"local": n, "devices": grid.nprocs,
+                       "dims": list(grid.dims), "platform": platform},
+            "speedup_vs_plain": round(times["plain"] / sec, 3),
+        })
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

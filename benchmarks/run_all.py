@@ -1,0 +1,57 @@
+"""Run every benchmark config feasible in this environment and collect the
+JSON lines under `benchmarks/results/`.
+
+Each benchmark runs in a fresh subprocess because virtual-device flags
+(`--xla_force_host_platform_device_count`) must be set before JAX initializes.
+Real-accelerator runs use the default backend; the virtual-mesh runs pin CPU.
+
+Usage: `python benchmarks/run_all.py [--quick]`.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+RESULTS = HERE / "results"
+
+
+def run(script: str, args, *, virtual: int = 0, tag: str) -> None:
+    env = dict(os.environ)
+    if virtual:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={virtual}").strip()
+    cmd = [sys.executable, str(HERE / script), *map(str, args)]
+    print(f"=== {tag}: {' '.join(cmd[1:])}" + (f" [virtual cpu x{virtual}]" if virtual else ""),
+          file=sys.stderr)
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         cwd=str(HERE.parent))
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        print(f"!!! {tag} failed (exit {out.returncode})", file=sys.stderr)
+        return
+    RESULTS.mkdir(exist_ok=True)
+    (RESULTS / f"{tag}.jsonl").write_text(out.stdout)
+    sys.stdout.write(out.stdout)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    # Headline: halo bandwidth + overlap study on the real accelerator (falls
+    # back to host CPU when none is attached).
+    run("halo_bandwidth.py", [] if not quick else [64, 2, 10], tag="halo_bandwidth")
+    run("overlap_study.py", [] if not quick else [64, 2, 10], tag="overlap_study")
+    # Multi-device program structure on a virtual 8-device CPU mesh (the
+    # environment-portable analog of the 2x2x2 BASELINE config).
+    run("halo_bandwidth.py", [32, 2, 5], virtual=8, tag="halo_bandwidth_mesh8")
+    run("weak_scaling.py", [], virtual=8, tag="weak_scaling_mesh8")
+    run("overlap_study.py", [32, 2, 5], virtual=8, tag="overlap_study_mesh8")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Weak-scaling harness: 3-D heat diffusion at a fixed per-device grid over
+growing device meshes (BASELINE.json configs 2 and 4; north-star target:
+>=90% parallel efficiency at 256^3/chip).
+
+Parallel efficiency = t(1 device) / t(N devices) at constant work per device —
+near-flat is ideal, the reference's published claim
+(`/root/reference/README.md:5-7`).
+
+Runs on whatever devices exist: a real pod slice measures ICI; a virtual CPU
+mesh (`XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu`)
+validates the harness and the compiled program structure (the collectives are
+real XLA collective-permutes, just over shared memory).
+
+Usage: `python benchmarks/weak_scaling.py [local_n] [nt] [n_inner]`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import emit, note
+
+
+def run_once(devices, n: int, *, nt: int, n_inner: int) -> float:
+    import igg
+    from igg.models import diffusion3d as d3
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True, devices=devices)
+    _, sec_per_step = d3.run(nt, dtype=np.float32, n_inner=n_inner,
+                             use_pallas=False)
+    igg.finalize_global_grid()
+    return sec_per_step
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (128 if platform != "cpu" else 32)
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (20 if platform != "cpu" else 5)
+
+    devices = jax.devices()
+    counts = [k for k in (1, 2, 4, 8, 16, 32, 64) if k <= len(devices)]
+    note(f"platform={platform} available={len(devices)} local={n}^3 "
+         f"counts={counts}")
+    if platform == "cpu":
+        note("virtual CPU mesh: all devices share one host's cores, so "
+             "efficiency below 1/N is expected and says nothing about ICI "
+             "scaling — this run validates the harness + program structure.")
+
+    t1 = None
+    for k in counts:
+        sec = run_once(devices[:k], n, nt=nt, n_inner=n_inner)
+        if t1 is None:
+            t1 = sec
+        eff = t1 / sec
+        emit({
+            "metric": "weak_scaling_efficiency",
+            "value": round(eff, 4),
+            "unit": "fraction",
+            "config": {"local": n, "devices": k, "platform": platform},
+            "ms_per_step": round(sec * 1e3, 4),
+        })
+
+
+if __name__ == "__main__":
+    main()

@@ -27,9 +27,12 @@ Semantics vs the sequential composition:
     same arithmetic on the same values);
   - open-boundary edge ranks: halo planes keep their *pre-compute* values
     (the reference's no-write semantics — its users' stencils never write
-    halo planes, `/root/reference/test/test_update_halo.jl:727-732`), whereas
-    the plain composition leaves whatever `compute` put there.  Halo cells at
-    an open boundary are not meaningful in either model.
+    halo planes, `/root/reference/test/test_update_halo.jl:727-732`) except
+    the corner/edge cells shared with an exchanged dimension, which carry
+    that dimension's received values (as in the reference, where the later
+    exchange overwrites them); the plain composition instead leaves whatever
+    `compute` put there.  Halo cells at an open boundary are not meaningful
+    in either model.
 
 Requirements on `compute`: a shift-invariant local stencil of radius
 `<= ol-1` per participating dimension (it is applied to thin slabs, so it
@@ -42,18 +45,8 @@ from __future__ import annotations
 from typing import Callable, Dict, Tuple
 
 from . import shared
-from .halo import exchange_planes
+from .halo import _plane, active_dims, assemble_planes, exchange_all_dims
 from .shared import NDIMS, GridError
-
-
-def _plane(A, d: int, i: int):
-    from jax import lax
-    return lax.slice_in_dim(A, i, i + 1, axis=d)
-
-
-def _put_plane(A, P, d: int, i: int):
-    from jax import lax
-    return lax.dynamic_update_slice_in_dim(A, P, i, axis=d)
 
 
 def hide_communication(A, compute: Callable, *aux, radius: int = 1):
@@ -80,17 +73,13 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1):
                 f"{s}; aux fields must match the primary field's local shape "
                 f"(pre-slice staggered coefficients inside `compute`).")
 
-    dims_active = []
-    for d in range(min(A.ndim, NDIMS)):
-        ol = grid.ol_of_local(d, s)
-        if ol < 2:
-            continue
+    dims_active = active_dims(s, grid)
+    for d, ol in dims_active:
         if radius > ol - 1:
             raise GridError(
                 f"hide_communication: stencil radius {radius} exceeds ol-1="
                 f"{ol - 1} along dimension {d}; the send planes cannot be "
                 f"computed from in-block data.")
-        dims_active.append((d, ol))
 
     # 1. Send planes from thin slab computations (independent of the full
     #    compute).  Slab [p-r, p+r] around send plane p; its center plane has
@@ -103,30 +92,13 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1):
             send[(d, side)] = _plane(compute(cut(A), *map(cut, aux)),
                                      d, radius)
 
-    # 2. Dimension-sequential plane-level exchange.  After dim d's exchange,
-    #    the *pending* send planes of later dimensions get their dim-d edge
-    #    rows overwritten with the received/stale halo rows — the plane-level
-    #    form of the reference's corner propagation
-    #    (`/root/reference/src/update_halo.jl:130`).
-    recv: Dict[Tuple[int, int], object] = {}
-    for i, (d, ol) in enumerate(dims_active):
-        new_first, new_last = exchange_planes(
-            send[(d, 0)], send[(d, 1)], _plane(A, d, 0), _plane(A, d, s[d] - 1),
-            d, grid.dims[d], bool(grid.periods[d]))
-        recv[(d, 0)], recv[(d, 1)] = new_first, new_last
-        for d2, ol2 in dims_active[i + 1:]:
-            for side2, p2 in ((0, ol2 - 1), (1, s[d2] - ol2)):
-                P = send[(d2, side2)]
-                P = _put_plane(P, _plane(new_first, d2, p2), d, 0)
-                P = _put_plane(P, _plane(new_last, d2, p2), d, s[d] - 1)
-                send[(d2, side2)] = P
+    # 2. Dimension-sequential plane-level exchange with corner propagation
+    #    (shared with the halo engine, :func:`igg.halo.exchange_all_dims`).
+    recv = exchange_all_dims(A, send, dims_active, grid)
 
     # 3. Full-domain compute — no data dependency on any ppermute above.
     out = compute(A, *aux)
 
     # 4. Assembly, in dimension order (later writes own the corner cells,
     #    like the reference's later exchanges).
-    for d, ol in dims_active:
-        out = _put_plane(out, recv[(d, 0)], d, 0)
-        out = _put_plane(out, recv[(d, 1)], d, s[d] - 1)
-    return out
+    return assemble_planes(out, recv, dims_active)

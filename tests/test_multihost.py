@@ -70,7 +70,18 @@ def test_two_controller_processes_match_single_controller(tmp_path):
         [sys.executable, str(worker), str(p), "2", port, str(out)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for p in range(2)]
-    logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    except subprocess.TimeoutExpired:
+        # Don't leave orphans holding the coordinator port; surface whatever
+        # output the workers produced before hanging.
+        partial = []
+        for p in procs:
+            p.kill()
+            rest, _ = p.communicate()
+            partial.append((rest or b"").decode())
+        pytest.fail("multihost workers timed out; partial output:\n"
+                    + "\n---\n".join(partial))
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log}"
 

