@@ -114,7 +114,7 @@ def _pallas_applicable(use_pallas, T) -> bool:
 
 
 def _best_bx(S0: int) -> int:
-    for b in (8, 4, 2):
+    for b in (16, 8, 4, 2):  # 16 measured fastest at 256^3 on v5e
         if S0 % b == 0:
             return b
     return 1

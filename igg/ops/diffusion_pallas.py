@@ -13,9 +13,13 @@ stencil update and `m` maps each halo index to its aliased interior index
 independently — the sequential x→y→z exchange is exactly what makes the
 per-dimension composition valid (corner/edge propagation,
 `/root/reference/src/update_halo.jl:130`).  The kernel computes `U` for its
-x-slab and assembles the y/z halo planes from `U` in VMEM; the two x halo
-planes are copied by a tiny epilogue (they are whole-plane aliases of updated
-interior planes).
+x-slab and assembles the y/z halo planes from `U` in VMEM.  The two x halo
+planes (`T_new[0] = U[s-2]·wrap`, `T_new[s-1] = U[1]·wrap`) are computed
+*outside* the kernel from 3-plane slices (O(s²) work) and written into the
+first/last programs' output blocks under `pl.when` — NOT patched in with a
+`dynamic_update_slice` epilogue, which would make XLA materialize a full-array
+copy per patched plane (the same conservative copy-insertion the halo engine
+works around, see `igg/halo.py::assemble_planes`).
 
 Blocking: the grid runs over x-slabs of `bx` rows; each program reads its
 slab, one periodic-neighbor plane on each side (single-plane BlockSpecs with
@@ -40,9 +44,20 @@ def pallas_supported(grid, T) -> bool:
     return T.shape[0] % 4 == 0 and T.shape[1] >= 8 and T.shape[2] >= 128
 
 
-def _kernel(c_ref, p_ref, n_ref, cp_ref, o_ref, *, rdx2, rdy2, rdz2, dt_lam,
-            bx):
+def _wrap_yz(U):
+    """Append the periodic y/z halo rows/columns of an interior-updated slab
+    (aliases of updated interior planes; order mirrors the reference's
+    sequential dims)."""
     import jax.numpy as jnp
+
+    U = jnp.concatenate([U[:, -1:, :], U, U[:, :1, :]], axis=1)
+    return jnp.concatenate([U[:, :, -1:], U, U[:, :, :1]], axis=2)
+
+
+def _kernel(c_ref, p_ref, n_ref, cp_ref, first_ref, last_ref, o_ref, *,
+            rdx2, rdy2, rdz2, dt_lam, bx, nb):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
 
     # Extended slab: [prev plane; slab; next plane] — one temporary, sliced
     # for all three axes' neighbors.
@@ -53,15 +68,35 @@ def _kernel(c_ref, p_ref, n_ref, cp_ref, o_ref, *, rdx2, rdy2, rdz2, dt_lam,
            + (ext[1:bx + 1, 1:-1, 2:] + ext[1:bx + 1, 1:-1, :-2]) * rdz2
            - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
     U = ctr + dt_lam / cp_ref[:, 1:-1, 1:-1] * lap
+    o_ref[:] = _wrap_yz(U)
 
-    # Assemble the y then z halo planes from U (periodic aliases of updated
-    # interior planes; order mirrors the reference's sequential dims).
-    Uy = jnp.concatenate([U[:, -1:, :], U, U[:, :1, :]], axis=1)
-    Uz = jnp.concatenate([Uy[:, :, -1:], Uy, Uy[:, :, :1]], axis=2)
-    o_ref[:] = Uz
+    # x halo planes (whole-plane aliases of updated interior planes,
+    # `/root/reference/src/update_halo.jl:386-405` with ol=2, self-wrap):
+    # precomputed outside, written by the edge programs only.
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[0:1] = first_ref[:]
+
+    @pl.when(i == nb - 1)
+    def _():
+        o_ref[bx - 1:bx] = last_ref[:]
 
 
-def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 4,
+def _plane_update(Tm1, T0, Tp1, Cp0, *, rdx2, rdy2, rdz2, dt_lam):
+    """Interior stencil update of one x-plane (`(S1, S2)` arrays), y/z halo
+    wrap included — the O(s²) host-side computation of `U[1]` and `U[s-2]`."""
+    ctr = T0[1:-1, 1:-1]
+    lap = ((Tp1[1:-1, 1:-1] + Tm1[1:-1, 1:-1]) * rdx2
+           + (T0[2:, 1:-1] + T0[:-2, 1:-1]) * rdy2
+           + (T0[1:-1, 2:] + T0[1:-1, :-2]) * rdz2
+           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
+    U = ctr + dt_lam / Cp0[1:-1, 1:-1] * lap
+    return _wrap_yz(U[None])[0]
+
+
+def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
                          interpret: bool = False):
     """One diffusion step `(T, Cp) -> T_new`, halo maintenance included.
     Must run under `jax.jit` (library call sites always do)."""
@@ -70,19 +105,28 @@ def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 4,
     from jax.experimental import pallas as pl
 
     S0, S1, S2 = T.shape
-    if S0 % bx != 0:
-        raise ValueError(f"x size {S0} not divisible by slab size {bx}")
+    while S0 % bx != 0:
+        bx //= 2
+    if bx < 1:
+        raise ValueError(f"x size {S0} has no power-of-two slab divisor")
     nb = S0 // bx
 
-    # Plain Python floats: baked into the kernel as compile-time constants.
-    kern = partial(_kernel, rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
-                   rdz2=1.0 / (dz * dz), dt_lam=float(dt * lam), bx=bx)
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz), dt_lam=float(dt * lam))
+
+    # T_new[0] = U[s-2] (y/z-wrapped), T_new[s-1] = U[1]: from 3-plane slices,
+    # purely functional (no in-place patching of the kernel output).
+    first = _plane_update(T[S0 - 3], T[S0 - 2], T[S0 - 1], Cp[S0 - 2], **scal)
+    last = _plane_update(T[0], T[1], T[2], Cp[1], **scal)
+
+    kern = partial(_kernel, bx=bx, nb=nb, **scal)
     kwargs = {}
     if not interpret:
         from jax.experimental.pallas import tpu as pltpu
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024)
-    out = pl.pallas_call(
+    plane = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
         grid=(nb,),
@@ -91,15 +135,10 @@ def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 4,
             pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
             pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
             pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+            plane,
+            plane,
         ],
         out_specs=pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
         interpret=interpret,
         **kwargs,
-    )(T, T, T, Cp)
-
-    # x halo planes: whole-plane aliases of updated interior planes
-    # (recv plane 0 <- plane s-2, plane s-1 <- plane 1;
-    #  `/root/reference/src/update_halo.jl:386-405` with ol=2, self-wrap).
-    out = out.at[0].set(out[S0 - 2])
-    out = out.at[S0 - 1].set(out[1])
-    return out
+    )(T, T, T, Cp, first[None], last[None])
