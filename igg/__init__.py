@@ -67,7 +67,9 @@ from .fields import (
 )
 from .overlap import hide_communication
 from .parallel import local_coords, sharded
+from .timing import time_steps
 from . import profiling
+from . import tools
 
 __version__ = "0.1.0"
 
@@ -84,5 +86,5 @@ __all__ = [
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
     "hide_communication", "local_coords", "sharded", "profiling",
-    "__version__",
+    "time_steps", "__version__",
 ]

@@ -25,9 +25,11 @@ def finalize_global_grid(*, shutdown_distributed: bool = False) -> None:
     from .halo import free_update_halo_buffers
     from .gather import free_gather_buffer
     from .parallel import free_sharded_cache
+    from .tools import free_barrier_cache
     free_update_halo_buffers()
     free_gather_buffer()
     free_sharded_cache()
+    free_barrier_cache()
 
     if shutdown_distributed and grid.distributed:
         import jax

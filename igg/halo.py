@@ -169,10 +169,16 @@ def exchange_all_dims(A, send: Dict, dims_active, grid) -> Dict:
     send = dict(send)
     # Stale planes: what an open-boundary edge device keeps (the reference's
     # no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
+    # Extracted only for non-periodic dims — periodic exchanges never read
+    # them, and a minor-dim plane slice costs nearly a full array pass on TPU
+    # (strided reads still transfer whole (8,128) tiles).
     stale = {}
     for d, ol in dims_active:
-        stale[(d, 0)] = _plane(A, d, 0)
-        stale[(d, 1)] = _plane(A, d, s[d] - 1)
+        if grid.periods[d]:
+            stale[(d, 0)] = stale[(d, 1)] = None
+        else:
+            stale[(d, 0)] = _plane(A, d, 0)
+            stale[(d, 1)] = _plane(A, d, s[d] - 1)
 
     recv: Dict[int, Tuple] = {}
     for i, (d, ol) in enumerate(dims_active):
@@ -187,10 +193,11 @@ def exchange_all_dims(A, send: Dict, dims_active, grid) -> Dict:
                 P = _put_plane(P, _plane(new_first, d2, p_send), d, 0)
                 P = _put_plane(P, _plane(new_last, d2, p_send), d, s[d] - 1)
                 send[(d2, side2)] = P
-                Q = stale[(d2, side2)]
-                Q = _put_plane(Q, _plane(new_first, d2, p_stale), d, 0)
-                Q = _put_plane(Q, _plane(new_last, d2, p_stale), d, s[d] - 1)
-                stale[(d2, side2)] = Q
+                if stale[(d2, side2)] is not None:
+                    Q = stale[(d2, side2)]
+                    Q = _put_plane(Q, _plane(new_first, d2, p_stale), d, 0)
+                    Q = _put_plane(Q, _plane(new_last, d2, p_stale), d, s[d] - 1)
+                    stale[(d2, side2)] = Q
     return recv
 
 

