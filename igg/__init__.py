@@ -68,6 +68,7 @@ from .fields import (
 from .overlap import hide_communication
 from .parallel import local_coords, sharded
 from .timing import time_steps
+from . import device
 from . import profiling
 from . import tools
 

@@ -1,28 +1,129 @@
 """Device selection.
 
-Counterpart of `/root/reference/src/select_device.jl`.  The reference maps the
-node-local MPI rank onto a CUDA device (`CUDA.device!(me_l)`); under JAX the
-runtime already binds each process to its local TPU chips and the mesh handles
-placement, so this is a thin parity shim that validates devices exist and
-returns the id of this process's primary device.
+Counterpart of `/root/reference/src/select_device.jl`.  The reference computes
+the *node-local* rank of the calling process via
+`MPI.Comm_split_type(COMM_TYPE_SHARED)` and binds it to the matching CUDA
+device, erroring when a node hosts more ranks than GPUs
+(`/root/reference/src/select_device.jl:13-27`).
+
+The JAX analog differs in one structural way: the runtime already assigns each
+controller process a *disjoint* set of local devices (`jax.local_devices()`),
+so processes can never silently share a chip the way MPI ranks share a GPU.
+What remains real work is (a) the node-local ordering of processes sharing a
+physical host, (b) the host-level over-subscription check — more processes on
+a host than the host has devices *in total* (the reference's exact error
+condition) — and (c) binding the selected device as JAX's default device.
+Host membership is established by allgathering a host fingerprint across
+processes, the collective analog of `Comm_split_type(SHARED)`.
+
+Like the reference's, :func:`select_device` is a *collective*: in a
+multi-process run every process must call it (directly or via
+``init_global_grid``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import socket
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
 from .shared import GridError, check_initialized
 
 
-def select_device() -> int:
-    """Return the id of the device this process primarily drives.
+def _host_fingerprint() -> np.ndarray:
+    """A stable per-host identifier, as two uint32s (transportable on meshes
+    without x64 enabled).  `--xla_force_host_platform_device_count` test
+    processes on one machine deliberately share a fingerprint — they model
+    multiple ranks on one node, the exact case the reference's
+    `Comm_split_type(SHARED)` exists for."""
+    digest = hashlib.sha1(socket.gethostname().encode()).digest()
+    lo = int.from_bytes(digest[0:4], "big")
+    hi = int.from_bytes(digest[4:8], "big")
+    return np.array([lo, hi], dtype=np.uint32)
 
-    Raises if no accelerator (or CPU fallback) device is available, mirroring
-    the reference's error when CUDA is not functional
-    (`/root/reference/src/select_device.jl:18`).
+
+def _same_host_processes() -> List[int]:
+    """Process indices sharing this host, in `process_index` order (the
+    reference's shared-memory communicator membership,
+    `/root/reference/src/select_device.jl:15-17`).  Collective when
+    `jax.process_count() > 1` (one allgather)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [0]
+    from jax.experimental import multihost_utils
+
+    mine = _host_fingerprint()
+    # (nprocs, 2): row p is process p's host fingerprint.
+    all_fp = np.asarray(multihost_utils.process_allgather(mine))
+    me = int(jax.process_index())
+    return [p for p in range(all_fp.shape[0])
+            if (all_fp[p] == all_fp[me]).all()]
+
+
+def node_local_rank() -> int:
+    """Rank of this process among the processes running on the same host —
+    the `me_l` the reference derives from `MPI.Comm_split_type`
+    (`/root/reference/src/select_device.jl:15-17`).  Collective in
+    multi-process runs."""
+    import jax
+
+    return _same_host_processes().index(int(jax.process_index()))
+
+
+def _select(me_l: int, n_procs_on_host: int, n_local: int,
+            n_host_devices: int) -> int:
+    """Pure device-selection decision: which local device index to bind, or
+    raise on over-subscription.  Split out for direct unit testing.
+
+    Over-subscription is a *host-level* condition, exactly the reference's
+    (`/root/reference/src/select_device.jl:18`): more processes on the host
+    than the host has devices in total.  (A per-process `me_l < n_local`
+    check would be wrong: in the standard one-device-per-process deployment,
+    rank 1 on a 4-chip host legitimately has `me_l == 1` and one local
+    device.)"""
+    if n_local == 0:
+        raise GridError("Cannot select a device: no JAX devices are "
+                        "available to this process.")
+    if n_procs_on_host > n_host_devices:
+        raise GridError(
+            f"Cannot select a device: this host runs {n_procs_on_host} "
+            f"processes but has only {n_host_devices} device(s) in total "
+            f"(the reference errors identically: "
+            f"/root/reference/src/select_device.jl:18).")
+    return me_l % n_local
+
+
+def select_device() -> int:
+    """Bind this process to its node-local device and return the device id.
+
+    Semantics mirror `/root/reference/src/select_device.jl:13-27`:
+    node-local rank selects among this process's local devices; raises when
+    the host runs more processes than it has devices, or when no devices are
+    available at all (the reference's "CUDA is not functional" error, `:18`).
+    Collective in multi-process runs (one allgather), like the reference's
+    `Comm_split_type`.
     """
     import jax
 
     check_initialized()
     devices = jax.local_devices()
-    if not devices:
-        raise GridError("Cannot select a device: no JAX devices are available.")
-    return devices[0].id
+
+    if jax.process_count() == 1:
+        if not devices:
+            raise GridError("Cannot select a device: no JAX devices are "
+                            "available to this process.")
+        return devices[0].id
+
+    same_host = _same_host_processes()
+    me_l = same_host.index(int(jax.process_index()))
+    host_procs = set(same_host)
+    n_host_devices = sum(1 for d in jax.devices()
+                         if d.process_index in host_procs)
+    idx = _select(me_l, len(same_host), len(devices), n_host_devices)
+
+    dev = devices[idx]
+    jax.config.update("jax_default_device", dev)
+    return dev.id

@@ -45,8 +45,11 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
     - ``init_distributed``: initialize ``jax.distributed`` for multi-host runs
       (the analog of ``init_MPI=true``; default off because single-controller
       JAX needs no process bootstrap on one host).
-    - ``select_device``: kept for API parity; device placement on TPU is
-      handled by the mesh, cf. :func:`igg.select_device`.
+    - ``select_device``: bind this process to its node-local device (the
+      reference auto-selects at init when CUDA is enabled,
+      `/root/reference/src/init_global_grid.jl:85`).  Only acts in
+      multi-process runs — single-controller placement is fully described by
+      the mesh; see :func:`igg.select_device`.
 
     Returns ``(me, dims, nprocs, coords, mesh)`` like the reference returns
     ``(me, dims, nprocs, coords, comm_cart)``
@@ -122,6 +125,14 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
         distributed=bool(init_distributed),
     )
     shared.set_global_grid(gg)
+
+    # Auto device selection (the reference's `select_device=true` default
+    # path, `/root/reference/src/init_global_grid.jl:85`): only meaningful —
+    # and only collective-safe — when several controller processes must agree
+    # on node-local device binding.
+    if select_device and jax.process_count() > 1:
+        from .device import select_device as _select_device
+        _select_device()
 
     if not quiet and me == 0:
         print(f"Global grid: {nxyz_g[0]}x{nxyz_g[1]}x{nxyz_g[2]} "

@@ -105,10 +105,10 @@ def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
     from jax.experimental import pallas as pl
 
     S0, S1, S2 = T.shape
+    if bx < 1 or (bx & (bx - 1)) != 0:
+        raise ValueError(f"bx must be a positive power of two, got {bx}")
     while S0 % bx != 0:
-        bx //= 2
-    if bx < 1:
-        raise ValueError(f"x size {S0} has no power-of-two slab divisor")
+        bx //= 2  # halving a power of two >= 1 always reaches a divisor (1)
     nb = S0 // bx
 
     scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),

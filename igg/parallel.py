@@ -18,7 +18,7 @@ import numpy as np
 
 from . import shared
 from .fields import spec_for
-from .shared import AXIS_NAMES, NDIMS
+from .shared import AXIS_NAMES, NDIMS, GridError
 
 
 def local_coords() -> Tuple:
@@ -58,6 +58,64 @@ def _leaf_spec(x, grid):
     if _is_grid_leaf(x, grid):
         return spec_for(len(x.shape))
     return P()
+
+
+# Primitives whose results differ per device even from replicated operands.
+_VARYING_PRIMS = frozenset({
+    "axis_index", "ppermute", "pshuffle", "all_to_all", "pgather",
+})
+
+
+def _params_contain_varying(params) -> bool:
+    """Whether any sub-jaxpr in an eqn's params (scan/cond/pjit/... bodies)
+    contains a device-varying primitive."""
+    from jax.extend import core
+
+    def walk(v) -> bool:
+        if isinstance(v, core.ClosedJaxpr):
+            return _jaxpr_contains_varying(v.jaxpr)
+        if isinstance(v, core.Jaxpr):
+            return _jaxpr_contains_varying(v)
+        if isinstance(v, (tuple, list)):
+            return any(walk(u) for u in v)
+        if isinstance(v, dict):
+            return any(walk(u) for u in v.values())
+        return False
+
+    return any(walk(v) for v in params.values())
+
+
+def _jaxpr_contains_varying(jaxpr) -> bool:
+    return any(e.primitive.name in _VARYING_PRIMS
+               or _params_contain_varying(e.params) for e in jaxpr.eqns)
+
+
+def _device_varying_outvars(jaxpr, in_varying, all_axes=None) -> list:
+    """Conservative taint analysis over a jaxpr: which outputs can hold
+    different values on different devices?  Taint sources are the sharded
+    inputs (`in_varying`) and device-varying primitives (`axis_index`,
+    `ppermute`, ... — including inside scan/cond/pjit sub-jaxprs); any eqn
+    touching taint taints all its outputs.  One untaint rule: a `psum` over
+    every (non-trivial) mesh axis yields the same value on all devices, so
+    its results are clean — this makes "reduce your diagnostic with psum"
+    an actually-working remedy.  Untainted outputs are provably identical on
+    every device, so replicating them is correct by construction — never a
+    shape-proximity guess."""
+    from jax.extend import core
+
+    all_axes = frozenset(all_axes or ())
+    tainted = {v for v, t in zip(jaxpr.invars, in_varying) if t}
+    for eqn in jaxpr.eqns:
+        if (eqn.primitive.name == "psum"
+                and eqn.params.get("axis_index_groups") is None
+                and all_axes <= set(eqn.params.get("axes", ()))):
+            continue  # full-mesh reduction: device-invariant result
+        if (eqn.primitive.name in _VARYING_PRIMS
+                or _params_contain_varying(eqn.params)
+                or any(isinstance(x, core.Var) and x in tainted
+                       for x in eqn.invars)):
+            tainted.update(eqn.outvars)
+    return [isinstance(v, core.Var) and v in tainted for v in jaxpr.outvars]
 
 
 def _local_aval(x, grid):
@@ -135,19 +193,70 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
 
                 in_specs = jax.tree.map(lambda x: _leaf_spec(x, grid), args)
                 if out_specs is None:
-                    # Infer the output structure by abstract tracing with the
-                    # mesh axes bound (so collectives/axis_index trace), then
-                    # assign specs by rank.
+                    # Infer the output specs by abstract tracing with the mesh
+                    # axes bound (so collectives/axis_index trace), combining
+                    # two facts per output leaf:
+                    #   - does its local shape look like a grid block
+                    #     (stagger/flux margin of the local grid size)?
+                    #   - can it hold *different values on different devices*
+                    #     (taint analysis, `_device_varying_outvars`)?
+                    # Device-varying grid-shaped outputs are grid fields
+                    # (replication is not even meaningful for them);
+                    # device-invariant non-grid outputs are replicated
+                    # (provably correct).  The two mixed cases are genuinely
+                    # ambiguous and raise, demanding explicit `out_specs` —
+                    # never a silent wrong answer (a replicated diagnostic
+                    # that happens to be (nx,ny,nz)-shaped must not be
+                    # concatenated into a fake "global" array).
                     local_avals = jax.tree.map(lambda x: _local_aval(x, grid), args)
                     axis_env = [(a, grid.dims[d])
                                 for d, a in enumerate(AXIS_NAMES)]
-                    _, out_aval = jax.make_jaxpr(
+                    jaxpr, out_aval = jax.make_jaxpr(
                         f, axis_env=axis_env, return_shape=True)(*local_avals)
-                    o_specs = jax.tree.map(
-                        lambda a: (spec_for(len(a.shape))
-                                   if _is_grid_local_shape(a.shape, grid)
-                                   else P()),
-                        out_aval)
+                    varying = _device_varying_outvars(
+                        jaxpr.jaxpr,
+                        [_is_grid_leaf(x, grid) for x in leaves],
+                        all_axes=[a for d, a in enumerate(AXIS_NAMES)
+                                  if grid.dims[d] > 1])
+                    out_leaves, out_tree = jax.tree.flatten(out_aval)
+                    if grid.nprocs == 1:
+                        # One device: sharding and replication coincide;
+                        # keep the historical (shard-grid-shaped) behavior.
+                        o_specs = out_tree.unflatten([
+                            spec_for(len(a.shape))
+                            if _is_grid_local_shape(a.shape, grid) else P()
+                            for a in out_leaves])
+                    else:
+                        specs_flat = []
+                        for i, (a, var) in enumerate(zip(out_leaves, varying)):
+                            gridlike = _is_grid_local_shape(a.shape, grid)
+                            if gridlike and var:
+                                specs_flat.append(spec_for(len(a.shape)))
+                            elif not gridlike and not var:
+                                specs_flat.append(P())
+                            elif gridlike:
+                                raise GridError(
+                                    f"igg.sharded: output leaf {i} has the "
+                                    f"local shape {tuple(a.shape)} of a grid "
+                                    f"block but is provably identical on "
+                                    f"every device — ambiguous between a "
+                                    f"constant grid field and a replicated "
+                                    f"diagnostic.  Pass out_specs= (e.g. "
+                                    f"igg.spec_for({len(a.shape)}) to stack "
+                                    f"it as a grid field, or "
+                                    f"jax.sharding.PartitionSpec() to keep "
+                                    f"one copy).")
+                            else:
+                                raise GridError(
+                                    f"igg.sharded: output leaf {i} with "
+                                    f"local shape {tuple(a.shape)} can "
+                                    f"differ per device but is not "
+                                    f"grid-block shaped — ambiguous (a "
+                                    f"per-device diagnostic?).  Reduce it "
+                                    f"(e.g. jax.lax.psum over "
+                                    f"igg.AXIS_NAMES) or pass explicit "
+                                    f"out_specs=.")
+                        o_specs = out_tree.unflatten(specs_flat)
                 else:
                     o_specs = out_specs
                 sm = jax.shard_map(f, mesh=grid.mesh,

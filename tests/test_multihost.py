@@ -36,6 +36,12 @@ me, dims, nprocs, coords, mesh = igg.init_global_grid(
     6, 6, 6, periodx=1, periodz=1, quiet=True)
 assert nprocs == 8, nprocs
 assert me == jax.process_index()
+# Real node-local device selection (both workers run on this machine, so they
+# model two ranks sharing one node: node-local ranks 0 and 1, each bound to
+# its own local device).  Collective — both processes call it together.
+assert igg.device.node_local_rank() == pid
+dev_id = igg.select_device()
+assert dev_id == jax.local_devices()[pid % 4].id, (dev_id, pid)
 A = igg.zeros((6, 6, 6))
 X, Y, Z = igg.coord_fields(1.0, 1.0, 1.0, A)
 A = A + X * 10000 + Y * 100 + Z
@@ -55,6 +61,8 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
 
 
 @pytest.mark.slow

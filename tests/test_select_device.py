@@ -1,0 +1,61 @@
+"""Device selection (`/root/reference/test/test_select_device.jl`).
+
+The reference's test is two-sided: a valid id is returned when a device is
+available, and an error is raised when it is not (`:17-26`).  Here the happy
+path runs for real; the decision logic (`igg.device._select`) is additionally
+unit-tested across deployment shapes — including the over-subscription error,
+which needs more processes on a host than the host has devices and so cannot
+be constructed with real virtual-CPU processes (each process always brings
+its own devices).
+"""
+
+import pytest
+
+import igg
+from igg.device import _select
+
+
+def test_select_device_returns_valid_id():
+    import jax
+
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    dev_id = igg.select_device()
+    assert dev_id in [d.id for d in jax.local_devices()]
+
+
+def test_select_device_requires_initialized_grid():
+    with pytest.raises(igg.GridError, match="init_global_grid"):
+        igg.select_device()
+
+
+def test_single_process_per_host_owning_all_chips():
+    # 1 process, 4 chips: bind the first local device.
+    assert _select(0, 1, 4, 4) == 0
+
+
+def test_one_device_per_process_deployment():
+    # 4 processes on a 4-chip host, each owning one (disjoint) chip: every
+    # rank binds its only local device — never an over-subscription error
+    # (devices are disjoint per process in JAX, unlike MPI+CUDA where all
+    # ranks see all node GPUs).
+    for me_l in range(4):
+        assert _select(me_l, 4, 1, 4) == 0
+
+
+def test_processes_sharing_visible_devices():
+    # 2 processes on one host, each seeing 4 (virtual) devices: node-local
+    # rank picks distinct devices.
+    assert _select(0, 2, 4, 8) == 0
+    assert _select(1, 2, 4, 8) == 1
+
+
+def test_oversubscribed_host_raises():
+    # 3 processes on a 2-device host: the reference's "more processes than
+    # GPUs per node" error (`/root/reference/src/select_device.jl:18`).
+    with pytest.raises(igg.GridError, match="runs 3 processes"):
+        _select(2, 3, 1, 2)
+
+
+def test_no_devices_raises():
+    with pytest.raises(igg.GridError, match="no JAX devices"):
+        _select(0, 1, 0, 0)
