@@ -37,25 +37,33 @@ def main():
 
     platform = jax.devices()[0].platform
     n = 256 if platform != "cpu" else 64
-    nt, n_inner = (4, 25) if platform != "cpu" else (2, 5)
+    # Big dispatches (100 steps per compiled program) so the timing slope is
+    # dominated by compute, not by the ~100ms tunnel-readback jitter; median
+    # of 3 runs per path (min of a noisy estimator biases low — observed
+    # "rates" above the chip's HBM peak with small batches).
+    nt, n_inner, reps = (12, 100, 3) if platform != "cpu" else (2, 5, 1)
 
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
     params = d3.Params()
 
-    _, xla_sec = d3.run(nt, params, dtype=np.float32, n_inner=n_inner,
-                        use_pallas=False)
+    def measure(**kw):
+        secs = []
+        for _ in range(reps):
+            _, sec = d3.run(nt, params, dtype=np.float32, n_inner=n_inner,
+                            **kw)
+            secs.append(sec)
+        return sorted(secs)[len(secs) // 2]
+
+    xla_sec = measure(use_pallas=False)
     pallas_sec = None
     if platform == "tpu":
-        import jax
-
         from igg.ops import pallas_supported
         # Shape-only query: no device allocation needed (or wanted — a real
         # 256^3 array would sit in HBM through the timed runs below).
         T0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
         if pallas_supported(grid, T0):
-            _, pallas_sec = d3.run(nt, params, dtype=np.float32,
-                                   n_inner=n_inner, use_pallas=True)
+            pallas_sec = measure(use_pallas=True)
 
     best = min(xla_sec, pallas_sec) if pallas_sec is not None else xla_sec
     ms = best * 1e3

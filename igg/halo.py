@@ -144,7 +144,8 @@ def active_dims(shape, grid) -> List[Tuple[int, int]]:
             if grid.ol_of_local(d, shape) >= 2]
 
 
-def exchange_all_dims(A, send: Dict, dims_active, grid) -> Dict:
+def exchange_all_dims(A, send: Dict, dims_active, grid,
+                      stale: Dict = None) -> Dict:
     """Dimension-sequential plane-level exchange with corner/edge propagation.
 
     `send[(d, side)]` are the packed send planes (already containing whatever
@@ -171,14 +172,17 @@ def exchange_all_dims(A, send: Dict, dims_active, grid) -> Dict:
     # no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
     # Extracted only for non-periodic dims — periodic exchanges never read
     # them, and a minor-dim plane slice costs nearly a full array pass on TPU
-    # (strided reads still transfer whole (8,128) tiles).
-    stale = {}
+    # (strided reads still transfer whole (8,128) tiles).  Callers holding
+    # the boundary planes in compact form already (e.g. the slab-carried
+    # Pallas path) pass them via `stale` to skip the slicing cost.
+    stale = dict(stale) if stale else {}
     for d, ol in dims_active:
         if grid.periods[d]:
             stale[(d, 0)] = stale[(d, 1)] = None
         else:
-            stale[(d, 0)] = _plane(A, d, 0)
-            stale[(d, 1)] = _plane(A, d, s[d] - 1)
+            for side, i in ((0, 0), (1, s[d] - 1)):
+                if (d, side) not in stale:
+                    stale[(d, side)] = _plane(A, d, i)
 
     recv: Dict[int, Tuple] = {}
     for i, (d, ol) in enumerate(dims_active):
@@ -226,7 +230,14 @@ def assemble_planes(out, recv: Dict, dims_active):
 def _update_halo_field(A, grid):
     """Halo update of one field's local block: pack send planes (inner plane
     `ol-1` / `s-ol`, `/root/reference/src/update_halo.jl:386-394`), exchange
-    dimension-sequentially with corner propagation, assemble in one pass."""
+    dimension-sequentially with corner propagation, assemble in one pass.
+
+    (When every active dimension is periodic with a single device and
+    overlap 2, the update is algebraically `pad(interior, mode='wrap')`;
+    measured on TPU that only pays off when the *producer* of `A` skips its
+    own boundary assembly so the pad fuses into one pass — see
+    `igg.models.diffusion3d`'s wrap fast path — and regresses here, where
+    `A` arrives fully assembled.)"""
     s = A.shape
     dims = active_dims(s, grid)
     send = {}

@@ -6,6 +6,13 @@ equivalent layer is Pallas kernels that fuse the stencil update with halo
 maintenance so each time step touches HBM exactly once per array.
 """
 
-from .diffusion_pallas import fused_diffusion_step, pallas_supported
+from .diffusion_pallas import (
+    diffusion_compute,
+    diffusion_interior,
+    fused_diffusion_step,
+    fused_diffusion_steps,
+    pallas_supported,
+)
 
-__all__ = ["fused_diffusion_step", "pallas_supported"]
+__all__ = ["diffusion_compute", "diffusion_interior", "fused_diffusion_step",
+           "fused_diffusion_steps", "pallas_supported"]

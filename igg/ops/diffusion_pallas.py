@@ -1,30 +1,48 @@
-"""Fused Pallas diffusion step (single-device, fully-periodic grid).
+"""Fused Pallas diffusion step — mesh-capable (any dims / periodicity).
 
 One kernel performs what the XLA path expresses as ~10 separate HBM-bound
 fusions (flux/Laplacian temporaries, interior dynamic-update-slice, six halo
 plane updates): read T and Cp once, write T once.
 
-Correctness model.  With overlap 2, a fully-periodic single-device grid, and
-the reference's step structure (interior update, then halo exchange dimension
-by dimension — `/root/reference/src/update_halo.jl:36`), the post-step array
-satisfies `T_new[i,j,k] = U[m(i), m(j), m(k)]` where `U` is the interior
-stencil update and `m` maps each halo index to its aliased interior index
-(`m(0) = s-2`, `m(s-1) = 1`, identity otherwise), applied per dimension
-independently — the sequential x→y→z exchange is exactly what makes the
-per-dimension composition valid (corner/edge propagation,
-`/root/reference/src/update_halo.jl:130`).  The kernel computes `U` for its
-x-slab and assembles the y/z halo planes from `U` in VMEM.  The two x halo
-planes (`T_new[0] = U[s-2]·wrap`, `T_new[s-1] = U[1]·wrap`) are computed
-*outside* the kernel from 3-plane slices (O(s²) work) and written into the
-first/last programs' output blocks under `pl.when` — NOT patched in with a
-`dynamic_update_slice` epilogue, which would make XLA materialize a full-array
-copy per patched plane (the same conservative copy-insertion the halo engine
-works around, see `igg/halo.py::assemble_planes`).
+Structure (the TPU-native re-expression of the reference's device-kernel
+layer, `/root/reference/src/update_halo.jl:439-486`, combined with
+ParallelStencil's `@hide_communication` overlap trade,
+`/root/reference/README.md:9`):
 
-Blocking: the grid runs over x-slabs of `bx` rows; each program reads its
-slab, one periodic-neighbor plane on each side (single-plane BlockSpecs with
-modular index maps — the in-kernel analog of the halo exchange), and the Cp
-slab.  HBM traffic per step: `T * (1 + 2/bx) + Cp + T_out`.
+1. **Send planes from thin-slab recomputation** — the inner boundary planes
+   `ol-1` / `s-ol` of the *updated* field
+   (`/root/reference/src/update_halo.jl:386-394`) are produced by radius-1
+   stencil applications on 3-plane slabs, O(s²) work independent of the main
+   kernel (the :func:`igg.hide_communication` recipe).
+2. **Dimension-sequential plane exchange** — `igg.halo.exchange_all_dims`,
+   the same engine the XLA path uses: ppermute per mesh axis, corner/edge
+   propagation by patching pending planes, open-boundary no-write via stale
+   planes, self-wrap local copies when a periodic dimension has one device
+   (`/root/reference/src/update_halo.jl:36,130,516-532`).
+3. **Fused compute + assembly kernel** — each program reads its x-slab of T
+   (plus one neighbor plane each side, modular index maps), computes the
+   interior update, and writes the output block with the *received* halo
+   planes assembled in dimension order (x plane first, y rows, then z
+   columns winning the shared corners — the in-VMEM equivalent of
+   `igg.halo.assemble_planes`).  HBM traffic per step:
+   `T*(1 + 2/bx) + Cp + T_out` + O(s²) plane traffic.
+
+**Slab carry (the multi-step fast path).**  Slicing 3-plane y/z slabs out of
+the big array costs far more than their size on TPU — a minor-dim slice
+still transfers whole (8,128) tiles, ~an eighth of the array for y and the
+*entire* array for z.  :func:`fused_diffusion_steps` therefore carries the
+four y/z boundary slabs of the field as separate compact arrays through the
+time loop: the kernel emits them as extra outputs (copies of its assembled
+output block's edge slabs, a few MB of dense writes), and the next
+iteration's send planes are computed from the carried slabs without touching
+the big array.  Cp's slabs are loop-invariant and sliced once.
+
+Because the send planes are recomputed rather than sliced from the kernel
+output, the exchange is data-independent of the main kernel; semantics match
+:func:`igg.hide_communication` exactly (identical to the plain sequential
+composition on periodic/interior ranks; at open-boundary edge ranks the
+physically-meaningless halo cells keep pre-step values).  On a sharded mesh
+this is the fused analog of running the XLA path with `overlap=True`.
 """
 
 from __future__ import annotations
@@ -33,91 +51,192 @@ from functools import partial
 
 
 def pallas_supported(grid, T) -> bool:
-    """Whether the fused kernel applies: single device, fully periodic,
-    overlap 2, 3-D unstaggered field, x divisible into slabs."""
-    if grid.nprocs != 1 or any(p == 0 for p in grid.periods):
-        return False
+    """Whether the fused kernel applies: 3-D unstaggered f32-shaped field
+    with overlap 2 in every dimension, local block large enough to slab
+    (any device count and any periodicity — the exchange engine handles
+    open boundaries and multi-device meshes)."""
     if grid.overlaps != (2, 2, 2) or T.ndim != 3:
         return False
-    if tuple(grid.local_shape_any(T)) != tuple(grid.nxyz):
+    s = tuple(grid.local_shape_any(T))
+    if s != tuple(grid.nxyz):
         return False
-    return T.shape[0] % 4 == 0 and T.shape[1] >= 8 and T.shape[2] >= 128
+    return s[0] % 4 == 0 and s[1] >= 8 and s[2] >= 128
 
 
-def _wrap_yz(U):
-    """Append the periodic y/z halo rows/columns of an interior-updated slab
-    (aliases of updated interior planes; order mirrors the reference's
-    sequential dims)."""
+def diffusion_interior(T, A, *, rdx2, rdy2, rdz2):
+    """Interior 7-point-Laplacian update `U` of a 3-D block, one cell
+    smaller per side — no boundary assembly.  `A` is the precomputed
+    coefficient field `dt*lam/Cp` (loop-invariant; hoisting the division out
+    of the time loop).  Building the full-size result is the caller's
+    choice: masked-select stale boundaries (:func:`diffusion_compute`), or
+    `jnp.pad(U, 1, mode='wrap')` on fully-periodic single-device grids,
+    where the wrap IS the halo exchange (self-neighbor path,
+    `/root/reference/src/update_halo.jl:516-532`) and fuses with this
+    stencil into one XLA pass."""
+    ctr = T[1:-1, 1:-1, 1:-1]
+    lap = ((T[2:, 1:-1, 1:-1] + T[:-2, 1:-1, 1:-1]) * rdx2
+           + (T[1:-1, 2:, 1:-1] + T[1:-1, :-2, 1:-1]) * rdy2
+           + (T[1:-1, 1:-1, 2:] + T[1:-1, 1:-1, :-2]) * rdz2
+           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
+    return ctr + A[1:-1, 1:-1, 1:-1] * lap
+
+
+def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):
+    """The pure stencil update on an arbitrary 3-D block: conservative
+    7-point-Laplacian interior update, boundary planes keep their stale
+    values (the reference's no-write semantics; physics of
+    `/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`,
+    flux divergence re-associated — see `igg.models.diffusion3d.compute_step`).
+    Shift-invariant and radius-1, so it applies equally to full local blocks
+    and to the 3-plane slabs that produce send planes."""
     import jax.numpy as jnp
+    from jax import lax
 
-    U = jnp.concatenate([U[:, -1:, :], U, U[:, :1, :]], axis=1)
-    return jnp.concatenate([U[:, :, -1:], U, U[:, :, :1]], axis=2)
+    U = diffusion_interior(T, A, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+    # Full-size assembly as a masked select (fuses into the same output pass;
+    # `.at[1:-1,...].add` would be a dynamic-update-slice that XLA turns into
+    # an extra full-array copy).
+    s = T.shape
+    inside = None
+    for d in range(3):
+        i = lax.broadcasted_iota(jnp.int32, s, d)
+        m = (i > 0) & (i < s[d] - 1)
+        inside = m if inside is None else inside & m
+    return jnp.where(inside, jnp.pad(U, 1), T)
 
 
-def _kernel(c_ref, p_ref, n_ref, cp_ref, first_ref, last_ref, o_ref, *,
-            rdx2, rdy2, rdz2, dt_lam, bx, nb):
-    import jax.numpy as jnp
+def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
+    # (k,S1,S2) row bands; Tm/Tp are the x-neighbors of T0's rows; A0 is the
+    # precomputed dt*lam/Cp coefficient band.
+    ctr = T0[:, 1:-1, 1:-1]
+    lap = ((Tp[:, 1:-1, 1:-1] + Tm[:, 1:-1, 1:-1]) * rdx2
+           + (T0[:, 2:, 1:-1] + T0[:, :-2, 1:-1]) * rdy2
+           + (T0[:, 1:-1, 2:] + T0[:, 1:-1, :-2]) * rdz2
+           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
+    return ctr + A0[:, 1:-1, 1:-1] * lap
+
+
+def _kernel_wrap(c_ref, p_ref, n_ref, a_ref, xf_ref, xl_ref, o_ref, *,
+                 rdx2, rdy2, rdz2, bx, nb):
+    """Self-wrap variant: every dimension is periodic with a single device,
+    so the y/z halo planes are aliases of updated interior planes assembled
+    for free in VMEM (the reference's self-neighbor path,
+    `/root/reference/src/update_halo.jl:516-532`, fused into the kernel).
+    Only the two x halo planes cross program boundaries and arrive as
+    precomputed wrapped planes.  This is the single-chip benchmark
+    configuration; no (S0,S1,1)-shaped z-plane arrays — whose minor-dim
+    padding makes their HBM I/O cost ~40x their logical size — ever touch
+    HBM."""
     from jax.experimental import pallas as pl
 
-    # Extended slab: [prev plane; slab; next plane] — one temporary, sliced
-    # for all three axes' neighbors.
-    ext = jnp.concatenate([p_ref[:], c_ref[:], n_ref[:]], axis=0)
-    ctr = ext[1:bx + 1, 1:-1, 1:-1]
-    lap = ((ext[2:bx + 2, 1:-1, 1:-1] + ext[0:bx, 1:-1, 1:-1]) * rdx2
-           + (ext[1:bx + 1, 2:, 1:-1] + ext[1:bx + 1, :-2, 1:-1]) * rdy2
-           + (ext[1:bx + 1, 1:-1, 2:] + ext[1:bx + 1, 1:-1, :-2]) * rdz2
-           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
-    U = ctr + dt_lam / cp_ref[:, 1:-1, 1:-1] * lap
-    o_ref[:] = _wrap_yz(U)
+    S1, S2 = c_ref.shape[1], c_ref.shape[2]
+    c = c_ref[:]
+    a = a_ref[:]
+    args = (rdx2, rdy2, rdz2)
+    if bx > 2:
+        o_ref[1:bx - 1, 1:-1, 1:-1] = _u_rows(
+            c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *args)
+    o_ref[0:1, 1:-1, 1:-1] = _u_rows(p_ref[:], c[0:1], c[1:2], a[0:1], *args)
+    o_ref[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
+        c[bx - 2:bx - 1], c[bx - 1:bx], n_ref[:], a[bx - 1:bx], *args)
 
-    # x halo planes (whole-plane aliases of updated interior planes,
-    # `/root/reference/src/update_halo.jl:386-405` with ol=2, self-wrap):
-    # precomputed outside, written by the edge programs only.
+    # y wrap from the updated interior (y halo = alias of inner plane):
+    o_ref[:, 0:1, 1:-1] = o_ref[:, S1 - 2:S1 - 1, 1:-1]
+    o_ref[:, S1 - 1:S1, 1:-1] = o_ref[:, 1:2, 1:-1]
+    # z wrap from the y-wrapped result (sequential-dimension order):
+    o_ref[:, :, 0:1] = o_ref[:, :, S2 - 2:S2 - 1]
+    o_ref[:, :, S2 - 1:S2] = o_ref[:, :, 1:2]
+
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
-        o_ref[0:1] = first_ref[:]
+        o_ref[0:1] = xf_ref[:]
 
     @pl.when(i == nb - 1)
     def _():
-        o_ref[bx - 1:bx] = last_ref[:]
+        o_ref[bx - 1:bx] = xl_ref[:]
 
 
-def _plane_update(Tm1, T0, Tp1, Cp0, *, rdx2, rdy2, rdz2, dt_lam):
-    """Interior stencil update of one x-plane (`(S1, S2)` arrays), y/z halo
-    wrap included — the O(s²) host-side computation of `U[1]` and `U[s-2]`."""
-    ctr = T0[1:-1, 1:-1]
-    lap = ((Tp1[1:-1, 1:-1] + Tm1[1:-1, 1:-1]) * rdx2
-           + (T0[2:, 1:-1] + T0[:-2, 1:-1]) * rdy2
-           + (T0[1:-1, 2:] + T0[1:-1, :-2]) * rdz2
-           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
-    U = ctr + dt_lam / Cp0[1:-1, 1:-1] * lap
-    return _wrap_yz(U[None])[0]
+def _kernel(c_ref, p_ref, n_ref, a_ref, rxf_ref, rxl_ref, ryf_ref, ryl_ref,
+            rzf_ref, rzl_ref, o_ref, oy_lo_ref, oy_hi_ref, oz_lo_ref,
+            oz_hi_ref, *, rdx2, rdy2, rdz2, bx, nb):
+    """One x-slab: interior stencil update + in-VMEM halo-plane assembly,
+    plus the output's y/z boundary slabs as compact extra outputs (consumed
+    by the slab-carry loop of :func:`fused_diffusion_steps`).
 
-
-def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
-                         interpret: bool = False):
-    """One diffusion step `(T, Cp) -> T_new`, halo maintenance included.
-    Must run under `jax.jit` (library call sites always do)."""
-    import jax
-    import jax.numpy as jnp
+    Assembly order realizes the reference's sequential-dimension semantics
+    (`/root/reference/src/update_halo.jl:36,130`): x halo planes first, then
+    y rows, then z columns — later dimensions own the shared corner/edge
+    cells, exactly like `igg.halo.assemble_planes`.  No extended-slab
+    concatenate: the update is written in three x-row bands whose outer rows
+    take their x-neighbor from the single-plane `p`/`n` refs."""
     from jax.experimental import pallas as pl
 
-    S0, S1, S2 = T.shape
-    if bx < 1 or (bx & (bx - 1)) != 0:
-        raise ValueError(f"bx must be a positive power of two, got {bx}")
+    S1, S2 = c_ref.shape[1], c_ref.shape[2]
+    c = c_ref[:]
+    a = a_ref[:]
+    args = (rdx2, rdy2, rdz2)
+    if bx > 2:
+        o_ref[1:bx - 1, 1:-1, 1:-1] = _u_rows(
+            c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *args)
+    o_ref[0:1, 1:-1, 1:-1] = _u_rows(p_ref[:], c[0:1], c[1:2], a[0:1], *args)
+    o_ref[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
+        c[bx - 2:bx - 1], c[bx - 1:bx], n_ref[:], a[bx - 1:bx], *args)
+
+    i = pl.program_id(0)
+
+    # x halo planes: received planes land in the first/last programs' rows
+    # (their y/z edge cells are overwritten below — x loses the corners).
+    @pl.when(i == 0)
+    def _():
+        o_ref[0:1, 1:-1, 1:-1] = rxf_ref[:, 1:-1, 1:-1]
+
+    @pl.when(i == nb - 1)
+    def _():
+        o_ref[bx - 1:bx, 1:-1, 1:-1] = rxl_ref[:, 1:-1, 1:-1]
+
+    # y halo rows (full x extent; z edges overwritten below).
+    o_ref[:, 0:1, 1:-1] = ryf_ref[:, :, 1:-1]
+    o_ref[:, S1 - 1:S1, 1:-1] = ryl_ref[:, :, 1:-1]
+    # z halo columns (own all shared corners).
+    o_ref[:, :, 0:1] = rzf_ref[:]
+    o_ref[:, :, S2 - 1:S2] = rzl_ref[:]
+
+    # Boundary slabs of the assembled output, emitted compactly.
+    oy_lo_ref[:] = o_ref[:, 0:3, :]
+    oy_hi_ref[:] = o_ref[:, S1 - 3:S1, :]
+    oz_lo_ref[:] = o_ref[:, :, 0:3]
+    oz_hi_ref[:] = o_ref[:, :, S2 - 3:S2]
+
+
+def _check_applicable(grid, s, bx):
+    from ..halo import active_dims
+
+    if bx < 2 or (bx & (bx - 1)) != 0:
+        raise ValueError(f"bx must be a power of two >= 2, got {bx}")
+    S0 = s[0]
     while S0 % bx != 0:
-        bx //= 2  # halving a power of two >= 1 always reaches a divisor (1)
+        bx //= 2  # halving reaches a divisor; S0 % 4 == 0 keeps bx >= 2
+    if bx < 2:
+        raise ValueError(f"x size {S0} not divisible into slabs of >= 2 rows")
+    dims_active = active_dims(s, grid)
+    if [d for d, _ in dims_active] != [0, 1, 2]:
+        raise ValueError(
+            f"fused kernel requires a halo in all three dimensions; active: "
+            f"{dims_active}")
+    return bx, dims_active
+
+
+def _call_kernel(T, A, recv, scal, bx, interpret):
+    """pallas_call plumbing: returns (out, ys_lo, ys_hi, zs_lo, zs_hi)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    s = T.shape
+    S0, S1, S2 = s
     nb = S0 // bx
-
-    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
-                rdz2=1.0 / (dz * dz), dt_lam=float(dt * lam))
-
-    # T_new[0] = U[s-2] (y/z-wrapped), T_new[s-1] = U[1]: from 3-plane slices,
-    # purely functional (no in-place patching of the kernel output).
-    first = _plane_update(T[S0 - 3], T[S0 - 2], T[S0 - 1], Cp[S0 - 2], **scal)
-    last = _plane_update(T[0], T[1], T[2], Cp[1], **scal)
+    (rxf, rxl), (ryf, ryl), (rzf, rzl) = recv[0], recv[1], recv[2]
 
     kern = partial(_kernel, bx=bx, nb=nb, **scal)
     kwargs = {}
@@ -125,20 +244,232 @@ def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
         from jax.experimental.pallas import tpu as pltpu
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024)
-    plane = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    # Under shard_map with varying-mesh-axes checking, out_shapes must carry
+    # which axes the results vary over: the union of the operands'.
+    operands = (T, T, T, A, rxf, rxl, ryf, ryl, rzf, rzl)
+    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
+    vma = frozenset().union(*[v for v in vmas if v])
+
+    def shp(*dims):
+        return (jax.ShapeDtypeStruct(dims, T.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(dims, T.dtype))
+
+    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
+        out_shape=(shp(S0, S1, S2), shp(S0, 3, S2), shp(S0, 3, S2),
+                   shp(S0, S1, 3), shp(S0, S1, 3)),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
             pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
             pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-            plane,
-            plane,
+            plane_x,
+            plane_x,
+            pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0))),
+        interpret=interpret,
+        **kwargs,
+    )(T, T, T, A, rxf, rxl, ryf, ryl, rzf, rzl)
+
+
+def _scal(dx, dy, dz):
+    return dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+
+
+def _self_wrap_all(grid) -> bool:
+    """All dims periodic with a single device: the reference's single-process
+    fully-periodic configuration, where every exchange is the self-neighbor
+    path (`/root/reference/src/update_halo.jl:516-532`)."""
+    return (tuple(grid.dims) == (1, 1, 1)
+            and all(bool(p) for p in grid.periods))
+
+
+def _wrap_plane_yz(P):
+    """Periodic y/z halo rows/columns of a (1,S1,S2) plane whose interior
+    holds updated values: halo = alias of the updated inner plane, y first
+    then z (the sequential-dimension order)."""
+    import jax.numpy as jnp
+
+    S1, S2 = P.shape[1], P.shape[2]
+    P = jnp.concatenate([P[:, S1 - 2:S1 - 1, :], P[:, 1:S1 - 1, :],
+                         P[:, 1:2, :]], axis=1)
+    return jnp.concatenate([P[:, :, S2 - 2:S2 - 1], P[:, :, 1:S2 - 1],
+                            P[:, :, 1:2]], axis=2)
+
+
+def _call_kernel_wrap(T, A, scal, bx, interpret):
+    """Self-wrap pallas_call: only the two precomputed wrapped x planes are
+    extra inputs; y/z halos assemble in VMEM.  Returns the updated block."""
+    import jax
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    s = T.shape
+    S0, S1, S2 = s
+    nb = S0 // bx
+
+    # T_new[0] = U[S0-2] / T_new[S0-1] = U[1], wrapped in y/z — from cheap
+    # contiguous 3-plane x-slabs.
+    xf = _wrap_plane_yz(_plane0(diffusion_compute(
+        lax.slice_in_dim(T, S0 - 3, S0, axis=0),
+        lax.slice_in_dim(A, S0 - 3, S0, axis=0), **scal)))
+    xl = _wrap_plane_yz(_plane0(diffusion_compute(
+        lax.slice_in_dim(T, 0, 3, axis=0),
+        lax.slice_in_dim(A, 0, 3, axis=0), **scal)))
+
+    kern = partial(_kernel_wrap, bx=bx, nb=nb, **scal)
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)
+    operands = (T, A, xf, xl)
+    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
+    vma = frozenset().union(*[v for v in vmas if v])
+    out_shape = (jax.ShapeDtypeStruct(s, T.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(s, T.dtype))
+    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    return pl.pallas_call(
+        kern,
+        out_shape=out_shape,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
+            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
+            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+            plane_x,
+            plane_x,
         ],
         out_specs=pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
         interpret=interpret,
         **kwargs,
-    )(T, T, T, Cp, first[None], last[None])
+    )(T, T, T, A, xf, xl)
+
+
+def _plane0(A):
+    """Center plane of a 3-plane x-slab."""
+    from jax import lax
+
+    return lax.slice_in_dim(A, 1, 2, axis=0)
+
+
+def _sends_and_stale(T, A_slabs, slabs, scal):
+    """Send planes (updated inner planes `ol-1`/`s-ol`) from compact boundary
+    slabs, plus stale (outermost) planes for open-boundary dims — no reads of
+    the big array beyond its two cheap contiguous x-end slabs."""
+    from jax import lax
+
+    from ..halo import _plane
+
+    s = T.shape
+    ys_lo, ys_hi, zs_lo, zs_hi = slabs
+    ax_lo, ax_hi, ay_lo, ay_hi, az_lo, az_hi = A_slabs
+    xs_lo = lax.slice_in_dim(T, 0, 3, axis=0)          # contiguous: cheap
+    xs_hi = lax.slice_in_dim(T, s[0] - 3, s[0], axis=0)
+
+    send = {
+        (0, 0): _plane(diffusion_compute(xs_lo, ax_lo, **scal), 0, 1),
+        (0, 1): _plane(diffusion_compute(xs_hi, ax_hi, **scal), 0, 1),
+        (1, 0): _plane(diffusion_compute(ys_lo, ay_lo, **scal), 1, 1),
+        (1, 1): _plane(diffusion_compute(ys_hi, ay_hi, **scal), 1, 1),
+        (2, 0): _plane(diffusion_compute(zs_lo, az_lo, **scal), 2, 1),
+        (2, 1): _plane(diffusion_compute(zs_hi, az_hi, **scal), 2, 1),
+    }
+    stale = {
+        (0, 0): xs_lo[0:1], (0, 1): xs_hi[2:3],
+        (1, 0): ys_lo[:, 0:1, :], (1, 1): ys_hi[:, 2:3, :],
+        (2, 0): zs_lo[:, :, 0:1], (2, 1): zs_hi[:, :, 2:3],
+    }
+    return send, stale
+
+
+def _boundary_slabs(A):
+    """The four y/z 3-plane boundary slabs of a block (one-time strided
+    extraction; thereafter the kernel re-emits them compactly)."""
+    from jax import lax
+
+    s = A.shape
+    return (lax.slice_in_dim(A, 0, 3, axis=1),
+            lax.slice_in_dim(A, s[1] - 3, s[1], axis=1),
+            lax.slice_in_dim(A, 0, 3, axis=2),
+            lax.slice_in_dim(A, s[2] - 3, s[2], axis=2))
+
+
+def _coef_slabs(A):
+    from jax import lax
+
+    s = A.shape
+    return (lax.slice_in_dim(A, 0, 3, axis=0),
+            lax.slice_in_dim(A, s[0] - 3, s[0], axis=0),
+            *_boundary_slabs(A))
+
+
+def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
+                         interpret: bool = False):
+    """One diffusion step `(T, Cp) -> T_new` on a per-device *local* block,
+    halo maintenance included.  Call inside SPMD code (`igg.sharded` /
+    shard_map) like :func:`igg.update_halo_local`; on a 1-device grid the
+    exchange degenerates to local copies and the function also works under
+    plain `jax.jit`.  For time loops use :func:`fused_diffusion_steps`,
+    which avoids the per-step strided slab extraction this entry pays."""
+    from ..halo import exchange_all_dims
+    from .. import shared
+
+    grid = shared.global_grid()
+    bx, dims_active = _check_applicable(grid, T.shape, bx)
+    scal = _scal(dx, dy, dz)
+    A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
+    if _self_wrap_all(grid):
+        return _call_kernel_wrap(T, A, scal, bx, interpret)
+    send, stale = _sends_and_stale(T, _coef_slabs(A), _boundary_slabs(T),
+                                   scal)
+    recv = exchange_all_dims(T, send, dims_active, grid, stale=stale)
+    return _call_kernel(T, A, recv, scal, bx, interpret)[0]
+
+
+def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
+                          bx: int = 16, interpret: bool = False):
+    """`n_inner` fused diffusion steps with boundary-slab carry (see module
+    docstring): the y/z slabs feeding each step's send planes are emitted by
+    the previous step's kernel, so the steady-state HBM traffic per step is
+    `T*(1 + 2/bx) + Cp + T_out` + a few MB of compact slab I/O.  Call inside
+    SPMD code; returns the advanced block."""
+    from jax import lax
+
+    from ..halo import exchange_all_dims
+    from .. import shared
+
+    grid = shared.global_grid()
+    bx, dims_active = _check_applicable(grid, T.shape, bx)
+    scal = _scal(dx, dy, dz)
+    A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
+
+    if _self_wrap_all(grid):
+        # Self-wrap: no slab carry needed — the only out-of-kernel work is
+        # two contiguous 3-plane x-slab stencils per step.
+        return lax.fori_loop(
+            0, n_inner,
+            lambda _, T: _call_kernel_wrap(T, A, scal, bx, interpret), T)
+
+    a_slabs = _coef_slabs(A)  # loop-invariant: sliced once
+
+    def body(_, carry):
+        T, *slabs = carry
+        send, stale = _sends_and_stale(T, a_slabs, slabs, scal)
+        recv = exchange_all_dims(T, send, dims_active, grid, stale=stale)
+        return _call_kernel(T, A, recv, scal, bx, interpret)
+
+    out = lax.fori_loop(0, n_inner, body, (T, *_boundary_slabs(T)))
+    return out[0]

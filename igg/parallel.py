@@ -159,7 +159,7 @@ def free_sharded_cache() -> None:
 
 
 def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
-            out_specs=None):
+            out_specs=None, check_vma: bool = True):
     """Compile `fn`, written over per-device *local* arrays (the reference's
     programming model: the user's solver sees `(nx, ny, nz)` arrays,
     `/root/reference/docs/examples/diffusion3D_multicpu_novis.jl:41-48`), into
@@ -184,7 +184,7 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
             grid = shared.global_grid()
             leaves, treedef = jax.tree.flatten(args)
             key = (shared.grid_epoch(), _fn_key(f), treedef,
-                   tuple(donate_argnums), repr(out_specs),
+                   tuple(donate_argnums), repr(out_specs), check_vma,
                    tuple((getattr(x, "shape", ()),
                           str(getattr(x, "dtype", type(x)))) for x in leaves))
             jfn = _compiled.get(key)
@@ -260,7 +260,8 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
                 else:
                     o_specs = out_specs
                 sm = jax.shard_map(f, mesh=grid.mesh,
-                                   in_specs=tuple(in_specs), out_specs=o_specs)
+                                   in_specs=tuple(in_specs),
+                                   out_specs=o_specs, check_vma=check_vma)
                 jfn = jax.jit(sm, donate_argnums=tuple(donate_argnums))
                 _compiled[key] = jfn
             out = jfn(*args)
