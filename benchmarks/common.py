@@ -28,10 +28,49 @@ if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
     jax.config.update("jax_platforms", "cpu")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _commit() -> "str | None":
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=str(pathlib.Path(__file__).resolve().parent),
+        ).stdout.strip() or None
+    except OSError:
+        return None
+
+
+def provenance() -> dict:
+    """Commit + timestamp + smoke flag stamped on every result line, so
+    checked-in artifacts are traceable to the code that produced them and
+    CPU-mesh lines can never be mistaken for accelerator evidence
+    (`smoke: true` = virtual-CPU-mesh run: validates program structure,
+    says nothing about TPU/ICI performance)."""
+    import jax
+
+    return {
+        "commit": _commit(),
+        "ts": int(__import__("time").time()),
+        "smoke": jax.devices()[0].platform == "cpu",
+    }
+
+
 def emit(record: dict, stream=sys.stdout) -> None:
-    """One JSON line per result (the contract of the repo's `bench.py`)."""
-    print(json.dumps(record), file=stream)
+    """One JSON line per result (the contract of the repo's `bench.py`),
+    stamped with provenance."""
+    print(json.dumps({**record, **provenance()}), file=stream)
     stream.flush()
+
+
+def median_of(fn, reps: int = 3):
+    """Median of `reps` calls — min of a noisy estimator biases low, and the
+    TPU tunnel's ~100ms readback jitter makes single measurements unreliable."""
+    vals = sorted(fn() for _ in range(reps))
+    return vals[len(vals) // 2]
 
 
 def note(msg: str) -> None:
@@ -40,21 +79,15 @@ def note(msg: str) -> None:
 
 
 def time_dispatches(fn, args, *, nt: int, warmup: int = 1):
-    """Seconds per dispatch of `fn(*args)`: `warmup` untimed calls (compile +
-    cache warm), then `nt` timed calls between `tic()` and `toc()`.
+    """Seconds per dispatch of `fn(*args)`, slope-measured via
+    `igg.time_steps` (two batch sizes; the constant dispatch/readback
+    latency — ~100ms on tunneled TPU runtimes — cancels in the slope; a
+    plain tic/toc over `nt` dispatches would be inflated by latency/nt).
 
-    `fn` must be side-effect-free w.r.t. `args` (no donation), so repeated
-    calls are valid.
-    """
-    import jax
-
+    `fn` must map `args` to same-structured outputs (a time-steppable
+    program); `nt` scales the batch sizes."""
     import igg
 
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    igg.tic()
-    for _ in range(nt):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    elapsed = igg.toc()
-    return elapsed / nt
+    n1 = max(1, nt)
+    _, sec = igg.time_steps(fn, args, n1=n1, n2=3 * n1, warmup=warmup)
+    return sec

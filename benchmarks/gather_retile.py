@@ -1,0 +1,81 @@
+"""Native (C++) gather re-tile vs the numpy fallback (VERDICT round-1 item
+9: the native path was claimed faster but never measured).
+
+Host-only benchmark: builds a block-stacked 3-D array and times
+`igg.native.retile` (threaded one-pass assembly, `igg/native/retile.cpp`)
+against the numpy take/concatenate fallback in `igg.gather.gather_interior`
+on identical inputs, checking the outputs match.
+
+Usage: `python benchmarks/gather_retile.py [local_n] [reps]`.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from common import emit, median_of, note
+
+
+def numpy_retile(stacked, dims, s, keep, full_last):
+    """The pure-numpy fallback path of `gather_interior` (kept in sync with
+    `igg/gather.py`)."""
+    out = stacked
+    for d in range(3):
+        pieces = []
+        for c in range(dims[d]):
+            block = np.take(out, range(c * s[d], (c + 1) * s[d]), axis=d)
+            if c == dims[d] - 1 and full_last[d]:
+                pieces.append(block)
+            else:
+                pieces.append(np.take(block, range(keep[d]), axis=d))
+        out = np.concatenate(pieces, axis=d) if len(pieces) > 1 else pieces[0]
+    return out
+
+
+def main():
+    from igg import native
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 192
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    dims, ol = (2, 2, 2), 2
+    s = (n, n, n)
+    keep = [n - ol] * 3
+    full_last = [True] * 3
+
+    rng = np.random.default_rng(0)
+    stacked = np.ascontiguousarray(
+        rng.standard_normal((2 * n, 2 * n, 2 * n)).astype(np.float32))
+    note(f"stacked {stacked.shape} f32 ({stacked.nbytes / 1e6:.0f} MB), "
+         f"native available: {native.available()}")
+
+    def t(fn):
+        def once():
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+        return median_of(once, reps)
+
+    np_sec = t(lambda: numpy_retile(stacked, dims, s, keep, full_last))
+    ref = numpy_retile(stacked, dims, s, keep, full_last)
+    out_bytes = ref.nbytes
+
+    emit({"metric": "gather_retile_numpy", "value": round(np_sec * 1e3, 2),
+          "unit": "ms", "config": {"local": n, "dims": list(dims)},
+          "gbps_out": round(out_bytes / np_sec / 1e9, 2)})
+
+    if native.available():
+        nat = native.retile(stacked, dims, s, keep, full_last)
+        np.testing.assert_array_equal(nat, ref)
+        nat_sec = t(lambda: native.retile(stacked, dims, s, keep, full_last))
+        emit({"metric": "gather_retile_native",
+              "value": round(nat_sec * 1e3, 2), "unit": "ms",
+              "config": {"local": n, "dims": list(dims)},
+              "gbps_out": round(out_bytes / nat_sec / 1e9, 2),
+              "speedup_vs_numpy": round(np_sec / nat_sec, 2)})
+
+
+if __name__ == "__main__":
+    main()

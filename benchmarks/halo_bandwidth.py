@@ -21,7 +21,7 @@ import sys
 
 import numpy as np
 
-from common import emit, note, time_dispatches
+from common import emit, median_of, note, time_dispatches
 
 
 def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
@@ -44,7 +44,7 @@ def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
     fn = jax.jit(jax.shard_map(body, mesh=grid.mesh,
                                in_specs=(spec,) * nfields,
                                out_specs=(spec,) * nfields))
-    sec = time_dispatches(fn, fields, nt=nt) / n_inner
+    sec = median_of(lambda: time_dispatches(fn, fields, nt=nt)) / n_inner
 
     itemsize = np.dtype(dtype).itemsize
     plane_bytes = n * n * itemsize
@@ -60,7 +60,7 @@ def main():
     platform = jax.devices()[0].platform
     n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform != "cpu" else 64)
     nt = int(sys.argv[2]) if len(sys.argv) > 2 else 5
-    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (50 if platform != "cpu" else 10)
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (200 if platform != "cpu" else 10)
 
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()

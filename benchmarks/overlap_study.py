@@ -1,18 +1,24 @@
-"""Comm/compute overlap study (BASELINE.json config 5: "comm/compute overlap
-(@hide_communication)").
+"""Comm/compute overlap study (BASELINE.json configs 4-5: the
+`@hide_communication` workloads).
 
-Times the diffusion step three ways on the same grid:
-  1. plain      — compute then `update_halo_local` (XLA may still overlap
-                  what the data flow allows);
-  2. hidden     — `igg.hide_communication`: send planes from thin slab
-                  recomputations, so the full-domain stencil is
-                  data-independent of every collective;
-  3. pallas     — the fused single-device kernel, where applicable (upper
-                  bound: no exchange, halo maintained in-kernel).
+Times each model's step on the same grid:
+  - plain    — compute then grouped `update_halo_local` (XLA may still
+               overlap what the data flow allows);
+  - hidden   — `igg.hide_communication`: send planes from thin slab
+               recomputations, so the full-domain stencil is
+               data-independent of every collective;
+  - pallas   — diffusion only: the fused kernel, where applicable.
 
-On a 1-device grid the exchange is HBM-local, so 1 vs 2 bounds the overhead of
-the restructuring itself; on a real multi-chip mesh the difference is hidden
-ICI latency.
+Models: `diffusion3d` (flagship, radius 1) and `stokes3d` (BASELINE config
+5's Stokes solver, radius 2 — run on an overlap-3 grid).  On a 1-device
+grid there is NO communication to hide (the exchange is HBM-local), so
+hidden-vs-plain measures pure restructuring overhead: ~0 for diffusion
+(radius-1, single-field slabs), substantial for Stokes (radius-2 slabs of
+five arrays, including minor-dim z-slabs).  The win appears where real
+collectives exist — on the 8-device mesh runs, hidden >= plain for both
+models (see overlap_study_mesh8.jsonl; smoke-flagged: CPU collectives, not
+ICI).  On real multi-chip TPU hardware the hidden variant is the intended
+configuration for Stokes; single-chip runs should use plain.
 
 Usage: `python benchmarks/overlap_study.py [local_n] [nt] [n_inner]`.
 """
@@ -23,34 +29,30 @@ import sys
 
 import numpy as np
 
-from common import emit, note
+from common import emit, median_of, note
 
 
-def main():
-    import jax
-
+def study_diffusion(n, nt, n_inner, platform):
     import igg
     from igg.models import diffusion3d as d3
 
-    platform = jax.devices()[0].platform
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform != "cpu" else 32)
-    nt = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (50 if platform != "cpu" else 5)
-
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
-    note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} local={n}^3")
+    note(f"diffusion3d platform={platform} devices={grid.nprocs} "
+         f"dims={grid.dims} local={n}^3")
 
     variants = [("plain", dict(use_pallas=False, overlap=False)),
                 ("hidden", dict(use_pallas=False, overlap=True))]
     from igg.ops import pallas_supported
-    T0 = igg.zeros((n, n, n), dtype=np.float32)
+    import jax
+    T0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
     if platform == "tpu" and pallas_supported(grid, T0):
-        variants.append(("pallas", dict(use_pallas=True, overlap=False)))
+        variants.append(("pallas", dict(use_pallas=True)))
 
     times = {}
     for name, kw in variants:
-        _, sec = d3.run(nt, dtype=np.float32, n_inner=n_inner, **kw)
+        sec = median_of(lambda: d3.run(nt, dtype=np.float32,
+                                       n_inner=n_inner, **kw)[1])
         times[name] = sec
         emit({
             "metric": f"diffusion3d_step_{name}",
@@ -61,6 +63,51 @@ def main():
             "speedup_vs_plain": round(times["plain"] / sec, 3),
         })
     igg.finalize_global_grid()
+
+
+def study_stokes(n, nt, n_inner, platform):
+    import igg
+    from igg.models import stokes3d
+
+    # Radius-2 update chain: overlap-3 grid (reference supports overlap>=3,
+    # `/root/reference/test/test_update_halo.jl:188-217`).
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"stokes3d platform={platform} devices={grid.nprocs} "
+         f"dims={grid.dims} local={n}^3 (overlap 3)")
+
+    times = {}
+    for name, ov in (("plain", False), ("hidden", True)):
+        sec = median_of(lambda: stokes3d.run(nt, dtype=np.float32,
+                                             overlap=ov,
+                                             n_inner=n_inner)[1])
+        times[name] = sec
+        emit({
+            "metric": f"stokes3d_iteration_{name}",
+            "value": round(sec * 1e3, 4),
+            "unit": "ms",
+            "config": {"local": n, "devices": grid.nprocs,
+                       "dims": list(grid.dims), "platform": platform,
+                       "overlap_cells": 3},
+            "speedup_vs_plain": round(times["plain"] / sec, 3),
+        })
+    igg.finalize_global_grid()
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform != "cpu" else 32)
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else (12 if platform != "cpu" else 3)
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (50 if platform != "cpu" else 5)
+
+    study_diffusion(n, nt, n_inner, platform)
+    # Stokes at 128^3+ per chip (VERDICT item 7's measurement); halve the
+    # grid on CPU smoke runs.
+    ns = max(128, n // 2) if platform != "cpu" else n
+    study_stokes(ns, nt, max(n_inner // 2, 2), platform)
 
 
 if __name__ == "__main__":

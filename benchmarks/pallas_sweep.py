@@ -2,54 +2,39 @@
 at 256^3 on the real TPU chip; records ms/step and achieved GB/s against the
 ideal-fusion traffic model (read T + Cp, write T = 3 * n^3 * 4 bytes).
 
-Writes one JSONL line per configuration to results/pallas_sweep.jsonl with a
-commit tag and timestamp (VERDICT round-1 items 3-4: recorded bx sweep,
-re-runnable artifacts).
+VERDICT round-1 items 3-4: the recorded bx sweep behind the default slab
+size, emitted as provenance-stamped JSON lines.
+
+Usage: `python benchmarks/pallas_sweep.py [n] [nt] [n_inner]`.
 """
 
-import json
-import os
-import subprocess
+from __future__ import annotations
+
 import sys
-import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from common import emit, median_of, note
 
 
-def main(out_path=None, repeats: int = 3):
+def main():
     import jax
 
     import igg
     from igg.models import diffusion3d as d3
 
     platform = jax.devices()[0].platform
-    n = 256 if platform == "tpu" else 64
-    commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                            capture_output=True, text=True,
-                            cwd=os.path.dirname(os.path.dirname(
-                                os.path.abspath(__file__)))).stdout.strip()
-    rows = []
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if platform == "tpu" else 64)
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else (12 if platform == "tpu" else 2)
+    n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (100 if platform == "tpu" else 5)
+
     cells = float(n) ** 3
     ideal_bytes = 3 * cells * 4
 
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"platform={platform} devices={grid.nprocs} local={n}^3")
     params = d3.Params()
-
-    def measure(**kw):
-        # Big dispatches (100 steps each) so the slope over dispatch counts
-        # is dominated by compute, not by the ~100ms tunnel readback whose
-        # run-to-run jitter otherwise corrupts small-batch slopes (observed:
-        # nonsense rates above the 819 GB/s v5e HBM peak).  Median of
-        # repeats, not min — min of a noisy estimator biases low.
-        n_inner = 100 if jax.devices()[0].platform == "tpu" else 5
-        secs = []
-        for _ in range(repeats):
-            _, sec = d3.run(12, params, dtype=np.float32, n_inner=n_inner,
-                            **kw)
-            secs.append(sec)
-        return sorted(secs)[len(secs) // 2]
 
     configs = [("xla", dict(use_pallas=False))]
     if platform == "tpu":
@@ -58,30 +43,18 @@ def main(out_path=None, repeats: int = 3):
             configs.append((f"pallas_bx{bx}", dict(use_pallas=True, bx=bx)))
     for tag, kw in configs:
         try:
-            sec = measure(**kw)
+            sec = median_of(lambda: d3.run(nt, params, dtype=np.float32,
+                                           n_inner=n_inner, **kw)[1])
         except Exception as e:  # e.g. VMEM overflow at large bx
-            print(json.dumps({"config": tag, "error": str(e)[:200]}),
-                  file=sys.stderr)
+            note(f"{tag}: FAILED {str(e)[:200]}")
             continue
-        row = {
-            "bench": "pallas_sweep", "config": tag, "n": n,
-            "ms_per_step": round(sec * 1e3, 4),
+        emit({
+            "metric": "pallas_sweep_ms_per_step", "config": tag, "local": n,
+            "value": round(sec * 1e3, 4), "unit": "ms",
             "gbps_ideal_traffic": round(ideal_bytes / sec / 1e9, 1),
-            "platform": platform, "smoke": platform != "tpu",
-            "commit": commit, "ts": int(time.time()),
-        }
-        rows.append(row)
-        print(json.dumps(row), file=sys.stderr)
+            "platform": platform,
+        })
     igg.finalize_global_grid()
-
-    if out_path is None:
-        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "results", "pallas_sweep.jsonl")
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, "w") as f:
-        for row in rows:
-            f.write(json.dumps(row) + "\n")
-    return rows
 
 
 if __name__ == "__main__":

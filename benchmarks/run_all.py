@@ -19,7 +19,8 @@ HERE = pathlib.Path(__file__).resolve().parent
 RESULTS = HERE / "results"
 
 
-def run(script: str, args, *, virtual: int = 0, tag: str) -> None:
+def run(script: str, args, *, virtual: int = 0, tag: str,
+        results: pathlib.Path = None) -> None:
     env = dict(os.environ)
     if virtual:
         env["JAX_PLATFORMS"] = "cpu"
@@ -34,23 +35,35 @@ def run(script: str, args, *, virtual: int = 0, tag: str) -> None:
     sys.stderr.write(out.stderr)
     if out.returncode != 0:
         print(f"!!! {tag} failed (exit {out.returncode})", file=sys.stderr)
-        return
-    RESULTS.mkdir(exist_ok=True)
-    (RESULTS / f"{tag}.jsonl").write_text(out.stdout)
+        sys.exit(1)
+    results = RESULTS if results is None else results
+    results.mkdir(exist_ok=True)
+    (results / f"{tag}.jsonl").write_text(out.stdout)
     sys.stdout.write(out.stdout)
 
 
 def main():
+    """One invocation refreshes every artifact under `results/`, each line
+    stamped with commit + timestamp and `smoke: true` on CPU-mesh runs
+    (virtual meshes validate program structure, not TPU/ICI performance)."""
     quick = "--quick" in sys.argv
-    # Headline: halo bandwidth + overlap study on the real accelerator (falls
-    # back to host CPU when none is attached).
-    run("halo_bandwidth.py", [] if not quick else [64, 2, 10], tag="halo_bandwidth")
-    run("overlap_study.py", [] if not quick else [64, 2, 10], tag="overlap_study")
+    # --quick is the CI/smoke mode: small configs, artifacts land in the
+    # gitignored results_smoke/ so committed accelerator evidence is never
+    # clobbered by a CPU run.
+    res = (HERE / "results_smoke") if quick else None
+    import functools
+    r = functools.partial(run, results=res)
+    # Headline: the real accelerator (falls back to host CPU when none is
+    # attached — those lines then carry smoke=true).
+    r("halo_bandwidth.py", [] if not quick else [64, 2, 10], tag="halo_bandwidth")
+    r("overlap_study.py", [] if not quick else [64, 2, 10], tag="overlap_study")
+    r("pallas_sweep.py", [] if not quick else [64, 2, 5], tag="pallas_sweep")
+    r("gather_retile.py", [] if not quick else [64, 3], tag="gather_retile")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).
-    run("halo_bandwidth.py", [32, 2, 5], virtual=8, tag="halo_bandwidth_mesh8")
-    run("weak_scaling.py", [], virtual=8, tag="weak_scaling_mesh8")
-    run("overlap_study.py", [32, 2, 5], virtual=8, tag="overlap_study_mesh8")
+    r("halo_bandwidth.py", [32, 2, 5], virtual=8, tag="halo_bandwidth_mesh8")
+    r("weak_scaling.py", [], virtual=8, tag="weak_scaling_mesh8")
+    r("overlap_study.py", [32, 2, 5], virtual=8, tag="overlap_study_mesh8")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Local CI runner — the same checks .github/workflows/ci.yml runs, executable
+# anywhere (the driver, a dev box) without GitHub.  Mirrors the reference's
+# CPU-only CI intent (`/root/reference/.github/workflows/ci.yml:1-42`) on the
+# virtual 8-device CPU mesh, which exercises the real shard_map/ppermute
+# multi-device programs.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "=== test suite (virtual 8-device CPU mesh, incl. multihost subprocess"
+echo "    test and interpret-mode Pallas tests) ==="
+python -m pytest tests/ -x -q
+
+echo "=== driver entry points (compile + 8-device dryrun) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py
+
+echo "=== benchmark harness smoke (--quick, CPU mesh; artifacts stamped"
+echo "    smoke=true) ==="
+python benchmarks/run_all.py --quick
+
+echo "CI PASS"

@@ -63,14 +63,31 @@ def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
     return None
 
 
-def _fetch_global(A) -> np.ndarray:
+# Device->host fetches larger than this are pulled in leading-dim slabs so
+# the transfer staging never needs a second whole-array host buffer (the
+# role of the reference's granularity-rounded persistent gather buffer,
+# `/root/reference/src/gather.jl:43-49`, is played by bounded staging here).
+_CHUNK_BYTES = 1 << 28  # 256 MB
+
+
+def _fetch_global(A, chunk_bytes: Optional[int] = None) -> np.ndarray:
     """Device→host fetch of a (possibly multi-host) grid array.  On a
     multi-host mesh, shards on non-addressable devices are exchanged over the
     runtime first (the role MPI point-to-point plays in the reference's
-    `cart_gather!`, `/root/reference/src/gather.jl:52-58`)."""
+    `cart_gather!`, `/root/reference/src/gather.jl:52-58`).  Fully-addressable
+    arrays above `chunk_bytes` stream to the host in leading-dim slabs."""
     import jax
 
     if getattr(A, "is_fully_addressable", True):
+        limit = _CHUNK_BYTES if chunk_bytes is None else chunk_bytes
+        nbytes = getattr(A, "nbytes", 0)
+        if nbytes > limit and getattr(A, "ndim", 0) >= 1 and A.shape[0] > 1:
+            rows = max(1, int(A.shape[0] * limit // nbytes))
+            out = np.empty(A.shape, dtype=A.dtype)
+            for i0 in range(0, A.shape[0], rows):
+                i1 = min(i0 + rows, A.shape[0])
+                out[i0:i1] = np.asarray(jax.device_get(A[i0:i1]))
+            return out
         return np.asarray(jax.device_get(A))
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(A, tiled=True))

@@ -54,8 +54,9 @@ def init_fields(params: Params = Params(), dtype=np.float32):
     return Pe, phi
 
 
-def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
-    """One coupled step over per-device local arrays."""
+def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
+    """The pure coupled update (no halo exchange): radius-1 shift-invariant,
+    usable full-domain and on :func:`igg.hide_communication` slabs."""
     k = (phi / phi0) ** npow
     # Face permeabilities (arithmetic mean) and Darcy fluxes on inner faces
     kx = 0.5 * (k[1:, 1:-1, 1:-1] + k[:-1, 1:-1, 1:-1])
@@ -73,25 +74,48 @@ def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
     # compaction: porosity responds to effective pressure
     phi = phi.at[inner].add(dt * (-phi[inner] * (1.0 - phi[inner])
                                   * Pe[inner] / eta))
-    return igg.update_halo_local(Pe, phi)
+    return Pe, phi
 
 
-def make_step(params: Params = Params(), *, donate: bool = True):
+def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
+               overlap: bool = False):
+    """One coupled step over per-device local arrays; two mutually-coupled
+    fields in one grouped halo update (multi-field pipelining,
+    `/root/reference/src/update_halo.jl:19-20`).  `overlap=True`
+    restructures with the multi-field :func:`igg.hide_communication`
+    (BASELINE config 4's weak-scaling workload)."""
+    kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
+    if overlap:
+        return igg.hide_communication(
+            (Pe, phi), lambda Pe, phi: compute_step(Pe, phi, **kw))
+    return igg.update_halo_local(*compute_step(Pe, phi, **kw))
+
+
+def make_step(params: Params = Params(), *, donate: bool = True,
+              overlap: bool = False, n_inner: int = 1):
+    from jax import lax
+
     dx, dy, dz = params.spacing()
     dt = params.timestep()
+    phi0, npow, eta = params.phi0, params.npow, params.eta
 
     def step(Pe, phi):
-        return local_step(Pe, phi, dx=dx, dy=dy, dz=dz, dt=dt,
-                          phi0=params.phi0, npow=params.npow, eta=params.eta)
+        return lax.fori_loop(
+            0, n_inner,
+            lambda _, S: local_step(*S, dx=dx, dy=dy, dz=dz, dt=dt,
+                                    phi0=phi0, npow=npow, eta=eta,
+                                    overlap=overlap),
+            (Pe, phi))
 
     return igg.sharded(step, donate_argnums=(0, 1) if donate else ())
 
 
-def run(nt: int, params: Params = Params(), dtype=np.float32):
+def run(nt: int, params: Params = Params(), dtype=np.float32,
+        overlap: bool = False, n_inner: int = 1):
     """Slope-timed run (see :func:`igg.time_steps`)."""
     Pe, phi = init_fields(params, dtype=dtype)
-    step = make_step(params)
+    step = make_step(params, overlap=overlap, n_inner=n_inner)
     n1 = max(1, nt // 4)
     state, sec = igg.time_steps(step, (Pe, phi),
                                 n1=n1, n2=max(nt - n1, n1 + 1))
-    return state, sec
+    return state, sec / n_inner

@@ -126,7 +126,13 @@ def _kernel_wrap(c_ref, p_ref, n_ref, a_ref, xf_ref, xl_ref, o_ref, *,
     precomputed wrapped planes.  This is the single-chip benchmark
     configuration; no (S0,S1,1)-shaped z-plane arrays — whose minor-dim
     padding makes their HBM I/O cost ~40x their logical size — ever touch
-    HBM."""
+    HBM.
+
+    Alias precision: the y/z halo planes are in-VMEM copies of their aliased
+    interior planes (bitwise equal); the x halo planes are computed by XLA
+    outside the kernel while their aliased interiors are computed by Mosaic
+    inside, so `T_new[0] == T_new[S0-2]` holds to 1 ulp, not bitwise
+    (measured max diff 1.5e-8 f32 on v5e; `tests/test_alias_invariant.py`)."""
     from jax.experimental import pallas as pl
 
     S1, S2 = c_ref.shape[1], c_ref.shape[2]

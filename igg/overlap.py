@@ -17,10 +17,21 @@ before the first flit leaves the chip.  :func:`hide_communication`
 restructures the step: the send planes are produced by thin, redundant *slab*
 computations (two `(1+2r)`-plane stencil applications per dimension), the
 dimension-sequential plane-level exchange runs on those — corner/edge
-propagation intact — and the full-domain `compute(A)` is data-independent of
+propagation intact — and the full-domain `compute` is data-independent of
 the entire exchange chain, so XLA's latency-hiding scheduler can run it while
 the collectives ride the ICI links.  Cost: recomputing ~6 boundary planes,
 O(s²) work against the O(s³) interior — the same trade ParallelStencil makes.
+
+Multi-field steps (e.g. the Stokes iteration, which updates and exchanges
+P/Vx/Vy/Vz together) pass a *tuple* of primary fields and a `compute`
+returning the same tuple; each field's send planes come from the same slab
+recomputations and each field is exchanged independently, exactly like a
+grouped `update_halo_local(P, Vx, Vy, Vz)`
+(`/root/reference/src/update_halo.jl:19-20`).  Staggered primaries and aux
+fields (local sizes differing from the base grid per dimension, reference
+`/root/reference/src/shared.jl:81`) are pre-sliced internally: every array's
+slab along `d` spans `[p - r, p + r + 1 + (size_d - base_d))`, so the
+overhang of a `(n+1)`-sized face field is preserved on the slab.
 
 Semantics vs the sequential composition:
   - fully periodic or interior ranks: identical (the exchanged planes are the
@@ -36,17 +47,19 @@ Semantics vs the sequential composition:
 
 Requirements on `compute`: a shift-invariant local stencil of radius
 `<= ol-1` per participating dimension (it is applied to thin slabs, so it
-must accept any extent along the grid dimensions — `jnp.roll`/shift-based
-stencils do).
+must accept any extent along the grid dimensions).  `radius` counts the full
+dependency chain: a Gauss-Seidel-style step whose later updates read earlier
+updates (e.g. Stokes velocities reading the freshly-updated pressure) has
+radius 2 and therefore needs grids initialized with overlap >= 3.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable
 
 from . import shared
 from .halo import _plane, active_dims, assemble_planes, exchange_all_dims
-from .shared import NDIMS, GridError
+from .shared import GridError
 
 
 def hide_communication(A, compute: Callable, *aux, radius: int = 1):
@@ -55,50 +68,107 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1):
     docstring).
 
     For use *inside* SPMD code (`igg.sharded` functions / shard_map), exactly
-    like :func:`igg.update_halo_local`; `A` is the per-device local block.
-    `aux` are read-only coefficient fields of the stencil (e.g. the heat
-    capacity in the diffusion model); they must have the same local shape as
-    `A` so they can be sliced into the same boundary slabs.  Returns the
-    updated block.
+    like :func:`igg.update_halo_local`; `A` is the per-device local block —
+    or a tuple of blocks for multi-field steps, with `compute` returning the
+    matching tuple.  `aux` are read-only coefficient fields of the stencil
+    (any stagger).  Returns the updated block(s).
     """
     from jax import lax
 
     shared.check_initialized()
     grid = shared.global_grid()
-    s = A.shape
-    for i, B in enumerate(aux):
-        if B.shape != s:
-            raise GridError(
-                f"hide_communication: aux field {i} has shape {B.shape} != "
-                f"{s}; aux fields must match the primary field's local shape "
-                f"(pre-slice staggered coefficients inside `compute`).")
 
-    dims_active = active_dims(s, grid)
-    for d, ol in dims_active:
-        if radius > ol - 1:
+    single = not isinstance(A, (tuple, list))
+    fields = (A,) if single else tuple(A)
+    if not fields:
+        raise GridError("hide_communication: no fields given.")
+    base = fields[0]
+    s0 = base.shape
+
+    dims_base = active_dims(s0, grid)
+    base_dims = [d for d, _ in dims_base]
+    per_field_dims = []
+    for i, F in enumerate(fields):
+        dims_f = active_dims(F.shape, grid)
+        if [d for d, _ in dims_f] != base_dims:
             raise GridError(
-                f"hide_communication: stencil radius {radius} exceeds ol-1="
-                f"{ol - 1} along dimension {d}; the send planes cannot be "
-                f"computed from in-block data.")
+                f"hide_communication: field {i} (local shape {F.shape}) has "
+                f"halos in dims {[d for d, _ in dims_f]} but the base field "
+                f"has {base_dims}; all primary fields must share the same "
+                f"exchanged dimensions.")
+        for d, ol in dims_f:
+            if radius > ol - 1:
+                raise GridError(
+                    f"hide_communication: stencil radius {radius} exceeds "
+                    f"ol-1={ol - 1} for field {i} along dimension {d}; the "
+                    f"send planes cannot be computed from in-block data "
+                    f"(initialize the grid with a larger overlap).")
+        per_field_dims.append(dims_f)
 
     # 1. Send planes from thin slab computations (independent of the full
-    #    compute).  Slab [p-r, p+r] around send plane p; its center plane has
-    #    all its stencil inputs inside the slab.
-    send: Dict[Tuple[int, int], object] = {}
-    for d, ol in dims_active:
-        for side, p in ((0, ol - 1), (1, s[d] - ol)):
-            cut = lambda B: lax.slice_in_dim(B, p - radius, p + radius + 1,
-                                             axis=d)
-            send[(d, side)] = _plane(compute(cut(A), *map(cut, aux)),
-                                     d, radius)
+    #    compute).  All arrays are cut with a COMMON start `lo` along `d`
+    #    (index alignment is what makes a shift-invariant stencil see the
+    #    slabs as a consistent window of the global arrays), each keeping
+    #    its stagger overhang `df = size_d - base_d` at the far end
+    #    (extent `E + df`).
+    #
+    #    Window algebra.  With aligned indexing, producing field g's value
+    #    at index i reads array f within
+    #        [i - r + min(0, df_f - df_g), i + r + max(0, df_f - df_g)]
+    #    (radius from the base lattice plus the relative stagger between
+    #    f's and g's lattices).  Send planes sit at q_g = p + df_g on side
+    #    0 and q_g = p on side 1 (since s_f - ol_f == s0 - ol).  Solving
+    #    "the window covers every primary's plane's reads in every array"
+    #    for the common start and extent gives the `lo`/`E` below; the
+    #    old `[p-r, p+r+1+df)` rule under-covered any field staggered
+    #    *smaller* than the base (its side-0 plane sits below the base's)
+    #    and side-1 reads reaching above a smaller field's overhang.
+    sends = [dict() for _ in fields]
+    for (d, ol) in dims_base:
+        dfs_all = [B.shape[d] - s0[d] for B in (*fields, *aux)]
+        dgs = [F.shape[d] - s0[d] for F in fields]
+        dgmin, dgmax = min(dgs), max(dgs)     # over primaries (incl. base 0)
+        dmin_all = min(dfs_all)               # over primaries and aux
+        for side, p in ((0, ol - 1), (1, s0[d] - ol)):
+            if side == 0:
+                lo = p - radius + min(dmin_all, dgmin)
+                E = 2 * radius + 1 - min(dmin_all, dgmin) \
+                    + max(0, dgmax - dmin_all)
+            else:
+                lo = p - radius + min(0, dmin_all - dgmax)
+                E = (p - lo) + radius + 1 - min(dmin_all, dgmin)
+            for B in (*fields, *aux):
+                df = B.shape[d] - s0[d]
+                if lo < 0 or lo + E + df > B.shape[d]:
+                    raise GridError(
+                        f"hide_communication: the send-plane window "
+                        f"[{lo}, {lo + E + df}) along dimension {d} exceeds "
+                        f"an array of local size {B.shape[d]}; increase the "
+                        f"grid overlap to accommodate radius {radius} with "
+                        f"staggers {sorted(set(dfs_all))}.")
 
-    # 2. Dimension-sequential plane-level exchange with corner propagation
-    #    (shared with the halo engine, :func:`igg.halo.exchange_all_dims`).
-    recv = exchange_all_dims(A, send, dims_active, grid)
+            def cut(B):
+                df = B.shape[d] - s0[d]
+                return lax.slice_in_dim(B, lo, lo + E + df, axis=d)
+
+            outs = compute(*(cut(F) for F in fields),
+                           *(cut(B) for B in aux))
+            outs = (outs,) if single else tuple(outs)
+            for i, out in enumerate(outs):
+                local_p = (p + dgs[i] if side == 0 else p) - lo
+                sends[i][(d, side)] = _plane(out, d, local_p)
+
+    # 2. Dimension-sequential plane-level exchange with corner propagation,
+    #    per field (shared with the halo engine).
+    recvs = [exchange_all_dims(F, sends[i], per_field_dims[i], grid)
+             for i, F in enumerate(fields)]
 
     # 3. Full-domain compute — no data dependency on any ppermute above.
-    out = compute(A, *aux)
+    outs = compute(*fields, *aux)
+    outs = (outs,) if single else tuple(outs)
 
     # 4. Assembly, in dimension order (later writes own the corner cells,
     #    like the reference's later exchanges).
-    return assemble_planes(out, recv, dims_active)
+    result = tuple(assemble_planes(out, recvs[i], per_field_dims[i])
+                   for i, out in enumerate(outs))
+    return result[0] if single else result

@@ -151,7 +151,27 @@ def _fn_key(f):
     return (code, cells)
 
 
-_compiled: Dict[tuple, object] = {}
+# LRU-bounded compiled-program cache.  The bound matters because `_fn_key`
+# falls back to identity for closures over unhashable captures — without
+# eviction, a `make_step()`-per-call usage pattern would leak one compiled
+# program per call for the life of the grid.
+_CACHE_CAP = 128
+_compiled: "OrderedDict[tuple, object]" = __import__(
+    "collections").OrderedDict()
+
+
+def _cache_put(key, value) -> None:
+    _compiled[key] = value
+    _compiled.move_to_end(key)
+    while len(_compiled) > _CACHE_CAP:
+        _compiled.popitem(last=False)
+
+
+def _cache_get(key):
+    value = _compiled.get(key)
+    if value is not None:
+        _compiled.move_to_end(key)
+    return value
 
 
 def free_sharded_cache() -> None:
@@ -187,7 +207,7 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
                    tuple(donate_argnums), repr(out_specs), check_vma,
                    tuple((getattr(x, "shape", ()),
                           str(getattr(x, "dtype", type(x)))) for x in leaves))
-            jfn = _compiled.get(key)
+            jfn = _cache_get(key)
             if jfn is None:
                 from jax.sharding import PartitionSpec as P
 
@@ -263,7 +283,7 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
                                    in_specs=tuple(in_specs),
                                    out_specs=o_specs, check_vma=check_vma)
                 jfn = jax.jit(sm, donate_argnums=tuple(donate_argnums))
-                _compiled[key] = jfn
+                _cache_put(key, jfn)
             out = jfn(*args)
             if grid.needs_cpu_sync:
                 jax.block_until_ready(out)

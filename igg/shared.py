@@ -148,9 +148,11 @@ class GlobalGrid:
 
     def local_shape_any(self, A) -> Tuple[int, ...]:
         """Per-device shape of `A`, which may be a stacked global jax.Array
-        (has a `.sharding`) or a host array already of local shape (the
-        reference's model where users own plain local arrays)."""
-        if hasattr(A, "sharding"):
+        (carries a `.sharding`) or a host array / ShapeDtypeStruct already of
+        local shape (the reference's model where users own plain local
+        arrays).  `ShapeDtypeStruct` exposes a `.sharding` attribute that is
+        None — only a real sharding marks a stacked array."""
+        if getattr(A, "sharding", None) is not None:
             return self.local_shape(A)
         return tuple(A.shape)
 

@@ -72,3 +72,34 @@ class TestGather:
         T = igg.zeros((6, 6, 6))
         out = igg.gather_interior(T)
         assert out.shape == (igg.nx_g(), igg.ny_g(), igg.nz_g()) == (8, 8, 8)
+
+    def test_non_default_root_returns_none_off_root(self):
+        """`/root/reference/test/test_gather.jl:127-150`: gather to a
+        non-zero root returns the result only there; everyone else gets
+        None.  This single-controller process is rank 0, so root=1 makes it
+        a non-root participant."""
+        igg.init_global_grid(4, 4, 4, overlapx=0, overlapy=0, overlapz=0,
+                             quiet=True)
+        A = encoded_field((4, 4, 4))
+        assert igg.gather(A, root=1) is None
+        assert igg.gather_interior(A, root=1) is None
+        # A_global may not be supplied on a non-root process (reference
+        # errors identically, `/root/reference/src/gather.jl:37`).
+        with pytest.raises(igg.GridError, match="must be None"):
+            igg.gather(A, np.zeros((8, 8, 8)), root=1)
+
+    def test_chunked_fetch_matches_whole_fetch(self):
+        """Large-array gathers stream device->host in leading-dim slabs;
+        forcing a tiny chunk size must reproduce the one-shot fetch
+        bit-for-bit."""
+        import importlib
+
+        gather_mod = importlib.import_module("igg.gather")
+
+        igg.init_global_grid(6, 6, 6, overlapx=0, overlapy=0, overlapz=0,
+                             quiet=True)
+        A = encoded_field((6, 6, 6))
+        whole = igg.gather(A)
+        np.testing.assert_array_equal(
+            gather_mod._fetch_global(A, chunk_bytes=1024).reshape(whole.shape),
+            whole.reshape(whole.shape))

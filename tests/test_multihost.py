@@ -52,6 +52,13 @@ if me == 0:
     np.save(outfile, out)
 else:
     assert out is None
+# Non-default root (reference /root/reference/test/test_gather.jl:127-150):
+# the result lands on rank 1, rank 0 gets None.
+out1 = igg.gather(A, root=1)
+if me == 1:
+    assert out1 is not None and out1.shape == (12, 12, 12), out1.shape
+else:
+    assert out1 is None
 igg.tic(); igg.toc()
 igg.finalize_global_grid()
 """

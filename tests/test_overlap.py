@@ -165,3 +165,44 @@ def test_self_neighbor_axis(eight_devices):
                                np.asarray(step_overlap(A0)),
                                rtol=1e-12, atol=1e-9)
     igg.finalize_global_grid()
+
+
+def test_multi_field_negative_stagger_base(eight_devices):
+    """Primaries where a field is staggered SMALLER than the base field
+    (df < 0): the slab window must extend below the base send plane, or the
+    smaller field's send plane silently carries pre-compute values.  Coupled
+    face/center pair with the face field first (base), fully periodic ->
+    hidden must match plain compute-then-exchange."""
+    igg.init_global_grid(8, 8, 8, periodx=1, periody=1, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+
+    def comp(Vx, P):
+        div = Vx[1:, :, :] - Vx[:-1, :, :]          # centered on P cells
+        Pn = P.at[1:-1, 1:-1, 1:-1].add(-0.1 * div[1:-1, 1:-1, 1:-1])
+        gr = P[1:, :, :] - P[:-1, :, :]             # on interior Vx faces
+        Vn = Vx.at[1:-1, 1:-1, 1:-1].add(-0.1 * gr[:, 1:-1, 1:-1])
+        return Vn, Pn
+
+    import jax.numpy as jnp
+    P0 = igg.zeros((8, 8, 8), dtype=np.float64)
+    X, Y, Z = igg.coord_fields(1.0, 1.0, 1.0, P0)
+    P0 = P0 + jnp.sin(X) + jnp.cos(2 * Y) + Z * 0.1
+    Vx0 = igg.zeros((9, 8, 8), dtype=np.float64) + 0.5
+
+    @igg.sharded
+    def step_plain(Vx, P):
+        return igg.update_halo_local(*comp(Vx, P))
+
+    @igg.sharded
+    def step_hidden(Vx, P):
+        return igg.hide_communication((Vx, P), comp)
+
+    for _ in range(3):
+        Vx_p, P_p = step_plain(Vx0, P0)
+        Vx_h, P_h = step_hidden(Vx0, P0)
+        Vx0, P0 = Vx_p, P_p
+    np.testing.assert_allclose(np.asarray(P_h), np.asarray(P_p),
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(Vx_h), np.asarray(Vx_p),
+                               rtol=1e-12, atol=1e-12)
+    igg.finalize_global_grid()
