@@ -19,24 +19,9 @@ import numpy as np
 from common import emit, median_of, note
 
 
-def numpy_retile(stacked, dims, s, keep, full_last):
-    """The pure-numpy fallback path of `gather_interior` (kept in sync with
-    `igg/gather.py`)."""
-    out = stacked
-    for d in range(3):
-        pieces = []
-        for c in range(dims[d]):
-            block = np.take(out, range(c * s[d], (c + 1) * s[d]), axis=d)
-            if c == dims[d] - 1 and full_last[d]:
-                pieces.append(block)
-            else:
-                pieces.append(np.take(block, range(keep[d]), axis=d))
-        out = np.concatenate(pieces, axis=d) if len(pieces) > 1 else pieces[0]
-    return out
-
-
 def main():
     from igg import native
+    from igg.gather import numpy_retile
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 192
     reps = int(sys.argv[2]) if len(sys.argv) > 2 else 5

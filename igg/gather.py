@@ -126,20 +126,29 @@ def gather_interior(A, *, root: int = 0):
         if out is not None:
             return out
 
+    ndim = min(A.ndim, NDIMS)
+    return numpy_retile(
+        stacked, [grid.dims[d] for d in range(ndim)],
+        [local[d] for d in range(ndim)],
+        [local[d] - max(grid.ol_of_local(d, local), 0) for d in range(ndim)],
+        [not grid.periods[d] for d in range(ndim)])
+
+
+def numpy_retile(stacked: np.ndarray, dims, s, keep, full_last) -> np.ndarray:
+    """Pure-numpy re-tile fallback: block `c` along each dim contributes its
+    first `keep` cells (the full `s` for the last block when `full_last`).
+    The contract `igg.native.retile` implements natively; also reused by
+    `benchmarks/gather_retile.py` so the benchmark always measures the loop
+    the library actually runs."""
     out = stacked
-    for d in range(min(A.ndim, NDIMS)):
-        n = grid.dims[d]
-        s = local[d]
-        ol = grid.ol_of_local(d, local)
-        keep = s - max(ol, 0)
+    for d in range(len(dims)):
         pieces = []
-        for c in range(n):
-            block = np.take(out, range(c * s, (c + 1) * s), axis=d)
-            last = (c == n - 1)
-            if last and not grid.periods[d]:
+        for c in range(dims[d]):
+            block = np.take(out, range(c * s[d], (c + 1) * s[d]), axis=d)
+            if c == dims[d] - 1 and full_last[d]:
                 pieces.append(block)
             else:
-                pieces.append(np.take(block, range(keep), axis=d))
+                pieces.append(np.take(block, range(keep[d]), axis=d))
         out = np.concatenate(pieces, axis=d) if len(pieces) > 1 else pieces[0]
     return out
 

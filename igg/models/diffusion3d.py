@@ -125,7 +125,10 @@ def _pallas_applicable(use_pallas, T, interpret: bool = False) -> bool:
 
 
 def _best_bx(S0: int) -> int:
-    for b in (16, 8, 4, 2):  # 16 measured fastest at 256^3 on v5e
+    # 8 measured fastest at 256^3 on v5e for the mega-kernel path (the
+    # per-step kernel is flat across 8..32); see
+    # benchmarks/results/pallas_sweep.jsonl.
+    for b in (8, 16, 4, 2):
         if S0 % b == 0:
             return b
     return 1

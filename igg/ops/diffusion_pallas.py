@@ -463,8 +463,16 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
     A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
 
     if _self_wrap_all(grid):
-        # Self-wrap: no slab carry needed — the only out-of-kernel work is
-        # two contiguous 3-plane x-slab stencils per step.
+        from .diffusion_mega import fused_diffusion_megasteps, mega_supported
+
+        # Fastest: the whole inner loop as ONE pallas_call with the
+        # coefficient array resident in VMEM (see `diffusion_mega`).
+        if mega_supported(T.shape, bx, n_inner, interpret):
+            return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
+                                             **scal)
+        # Self-wrap per-step kernel: no slab carry needed — the only
+        # out-of-kernel work is two contiguous 3-plane x-slab stencils per
+        # step.
         return lax.fori_loop(
             0, n_inner,
             lambda _, T: _call_kernel_wrap(T, A, scal, bx, interpret), T)

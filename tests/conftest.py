@@ -18,10 +18,17 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-# The reference test suite works in Float64 (Julia default); enable x64 so the
-# golden values transfer verbatim.  Library code itself is dtype-agnostic.
-jax.config.update("jax_enable_x64", True)
+if os.environ.get("IGG_TPU_TESTS") == "1":
+    # Escape hatch for the TPU-only tests (tests/test_mega_tpu.py): leave
+    # the real backend in place.  Only run the TPU-marked files this way —
+    # the rest of the suite expects the 8-device CPU mesh below.
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
+    # The reference test suite works in Float64 (Julia default); enable x64
+    # so the golden values transfer verbatim.  Library code itself is
+    # dtype-agnostic.
+    jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

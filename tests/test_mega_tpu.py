@@ -1,0 +1,55 @@
+"""TPU-only: the K-step mega-kernel must match the per-step fused kernel.
+
+The mega-kernel uses manual TPU DMA/semaphores, which have no interpret
+mode, so this test can only run against real TPU hardware.  The suite's
+conftest pins the CPU backend; run this file with the escape hatch:
+
+    IGG_TPU_TESTS=1 python -m pytest tests/test_mega_tpu.py -q
+
+(`bench.py` also runs the mega path on every TPU benchmark invocation, so
+the driver exercises it each round.)
+"""
+
+import numpy as np
+import pytest
+
+import igg
+
+
+def _tpu_available() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_mega_matches_per_step_kernel():
+    import jax.numpy as jnp
+
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_mega import fused_diffusion_megasteps, \
+        mega_supported
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    dx, dy, dz = params.spacing()
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+    A = float(params.timestep() * params.lam) / Cp
+    assert mega_supported(T.shape, 8, 6, interpret=False)
+
+    out = fused_diffusion_megasteps(T, A, n_inner=6, bx=8, **scal)
+
+    from igg.ops.diffusion_pallas import _call_kernel_wrap
+    import jax
+    ref = T
+    step = jax.jit(lambda T: _call_kernel_wrap(T, A, scal, 8, False))
+    for _ in range(6):
+        ref = step(ref)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale
