@@ -51,6 +51,8 @@ def mega_supported(shape, bx: int, n_inner: int, interpret: bool) -> bool:
     if interpret or n_inner < 2:
         return False
     S0, S1, S2 = shape
+    if S0 % bx != 0:  # nb = S0 // bx must cover every row
+        return False
     if S0 < 2 * bx:  # the wrapping edge fetches assume >= 2 slabs per step
         return False
     need = 4 * (S0 * S1 * S2            # A resident
@@ -60,13 +62,9 @@ def mega_supported(shape, bx: int, n_inner: int, interpret: bool) -> bool:
     return need <= _VMEM_BUDGET
 
 
-def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
-    ctr = T0[:, 1:-1, 1:-1]
-    lap = ((Tp[:, 1:-1, 1:-1] + Tm[:, 1:-1, 1:-1]) * rdx2
-           + (T0[:, 2:, 1:-1] + T0[:, :-2, 1:-1]) * rdy2
-           + (T0[:, 1:-1, 2:] + T0[:, 1:-1, :-2]) * rdz2
-           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
-    return ctr + A0[:, 1:-1, 1:-1] * lap
+# Shared with the per-step kernel: the 1-ulp equality contract between the
+# two paths (tests/test_mega_tpu.py) depends on literally the same stencil.
+from .diffusion_pallas import _u_rows  # noqa: E402
 
 
 def _kernel(T_hbm, A_hbm, out_ref, buf0, buf1,

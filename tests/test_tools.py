@@ -163,3 +163,31 @@ def test_coord_fields_broadcast():
         val = (igg.x_g(2, 1.0, probe, c) + igg.y_g(1, 1.0, probe, c)
                + igg.z_g(3, 1.0, probe, c))
         assert F_np[c[0] * 4 + 2, c[1] * 4 + 1, c[2] * 4 + 3] == pytest.approx(val)
+
+
+def test_barrier_is_single_scalar_collective():
+    """VERDICT round-1 item 10: `barrier()` must stay flat in device count —
+    one compiled program reducing ONE scalar token over the mesh plus one
+    host read, not a per-device host loop.  Asserted structurally on the
+    lowered program: exactly one all-reduce, scalar-shaped."""
+    import jax
+
+    import igg
+    from igg import tools
+
+    igg.init_global_grid(6, 6, 6, quiet=True)  # 8 devices
+    igg.barrier()
+    fn = next(iter(tools._barrier_fns.values()))
+    import re
+
+    hlo = fn.lower().compile().as_text()
+    # sync or async lowering; must be present (the collective exists) and
+    # not multiplied into a per-device loop of collectives
+    n_allreduce = len(re.findall(r"all-reduce(?:-start)?\(", hlo))
+    assert 1 <= n_allreduce <= 2, hlo[:2000]
+    assert "f32[]" in hlo                  # scalar token
+    # and it is cached: a second call compiles nothing new
+    n = len(tools._barrier_fns)
+    igg.barrier()
+    assert len(tools._barrier_fns) == n
+    igg.finalize_global_grid()
