@@ -145,7 +145,7 @@ def active_dims(shape, grid) -> List[Tuple[int, int]]:
 
 
 def exchange_all_dims(A, send: Dict, dims_active, grid,
-                      stale: Dict = None) -> Dict:
+                      stale: Dict = None, wrap=()) -> Dict:
     """Dimension-sequential plane-level exchange with corner/edge propagation.
 
     `send[(d, side)]` are the packed send planes (already containing whatever
@@ -162,12 +162,20 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
     dimension order (later dimensions win the shared corner/edge cells, like
     the reference's later exchanges overwrite them).
 
+    Dims in `wrap` (single periodic device, halo assembled by the caller —
+    e.g. in-VMEM by the fused Pallas kernel) are not exchanged and need no
+    send planes; their contribution to the sequential semantics is the
+    self-alias patch: later dims' pending planes get the wrapped halo rows,
+    which are aliases of the plane's own inner rows.
+
     Shared by :func:`igg.update_halo` / :func:`igg.update_halo_local` (send
-    planes sliced from the block) and :func:`igg.hide_communication` (send
-    planes from thin slab recomputations).
+    planes sliced from the block), :func:`igg.hide_communication` (send
+    planes from thin slab recomputations), and the fused Pallas path (send
+    planes from carried boundary slabs, wrap dims in-kernel).
     """
     s = A.shape
     send = dict(send)
+    wrap = frozenset(wrap)
     # Stale planes: what an open-boundary edge device keeps (the reference's
     # no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
     # Extracted only for non-periodic dims — periodic exchanges never read
@@ -177,7 +185,7 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
     # Pallas path) pass them via `stale` to skip the slicing cost.
     stale = dict(stale) if stale else {}
     for d, ol in dims_active:
-        if grid.periods[d]:
+        if d in wrap or grid.periods[d]:
             stale[(d, 0)] = stale[(d, 1)] = None
         else:
             for side, i in ((0, 0), (1, s[d] - 1)):
@@ -186,11 +194,28 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
 
     recv: Dict[int, Tuple] = {}
     for i, (d, ol) in enumerate(dims_active):
+        if d in wrap:
+            # Self-alias patch of every later pending plane: the wrapped
+            # halo rows along `d` are the plane's own inner rows.
+            for d2, ol2 in dims_active[i + 1:]:
+                if d2 in wrap:
+                    continue
+                for side2 in (0, 1):
+                    for store in (send, stale):
+                        P = store.get((d2, side2))
+                        if P is None:
+                            continue
+                        P = _put_plane(P, _plane(P, d, s[d] - 2), d, 0)
+                        P = _put_plane(P, _plane(P, d, 1), d, s[d] - 1)
+                        store[(d2, side2)] = P
+            continue
         new_first, new_last = exchange_planes(
             send[(d, 0)], send[(d, 1)], stale[(d, 0)], stale[(d, 1)],
             d, grid.dims[d], bool(grid.periods[d]))
         recv[d] = (new_first, new_last)
         for d2, ol2 in dims_active[i + 1:]:
+            if d2 in wrap:
+                continue
             for side2, p_send, p_stale in ((0, ol2 - 1, 0),
                                            (1, s[d2] - ol2, s[d2] - 1)):
                 P = send[(d2, side2)]

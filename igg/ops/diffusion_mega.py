@@ -18,7 +18,7 @@ structural wins over one-kernel-per-step:
      written before it is read, plus a final drain).
 
 Halo maintenance is the self-wrap scheme of
-`diffusion_pallas._kernel_wrap`: y/z halos are VMEM aliases of the updated
+`diffusion_pallas._make_kernel` in wrap mode: y/z halos are VMEM aliases of the updated
 interior; the two x halo planes are computed by the first program of each
 step from 3-plane x-end slabs of the current source buffer
 (`/root/reference/src/update_halo.jl:516-532` — every exchange is the
@@ -170,7 +170,7 @@ def _kernel(T_hbm, A_hbm, out_ref, buf0, buf1,
                                    a_vmem[1:2], *scal))
 
     # Interior stencil update in x-row bands + y/z self-wrap assembly
-    # (identical scheme to diffusion_pallas._kernel_wrap).
+    # (identical scheme to diffusion_pallas._make_kernel in wrap mode).
     ext = ext2.at[sl]
     o_vmem = o2.at[sl]
     c = ext[1:bx + 1]

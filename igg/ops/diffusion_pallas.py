@@ -116,104 +116,103 @@ def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
     return ctr + A0[:, 1:-1, 1:-1] * lap
 
 
-def _kernel_wrap(c_ref, p_ref, n_ref, a_ref, xf_ref, xl_ref, o_ref, *,
-                 rdx2, rdy2, rdz2, bx, nb):
-    """Self-wrap variant: every dimension is periodic with a single device,
-    so the y/z halo planes are aliases of updated interior planes assembled
-    for free in VMEM (the reference's self-neighbor path,
-    `/root/reference/src/update_halo.jl:516-532`, fused into the kernel).
-    Only the two x halo planes cross program boundaries and arrive as
-    precomputed wrapped planes.  This is the single-chip benchmark
-    configuration; no (S0,S1,1)-shaped z-plane arrays — whose minor-dim
-    padding makes their HBM I/O cost ~40x their logical size — ever touch
-    HBM.
-
-    Alias precision: the y/z halo planes are in-VMEM copies of their aliased
-    interior planes (bitwise equal); the x halo planes are computed by XLA
-    outside the kernel while their aliased interiors are computed by Mosaic
-    inside, so `T_new[0] == T_new[S0-2]` holds to 1 ulp, not bitwise
-    (measured max diff 1.5e-8 f32 on v5e; `tests/test_alias_invariant.py`)."""
-    from jax.experimental import pallas as pl
-
-    S1, S2 = c_ref.shape[1], c_ref.shape[2]
-    c = c_ref[:]
-    a = a_ref[:]
-    args = (rdx2, rdy2, rdz2)
-    if bx > 2:
-        o_ref[1:bx - 1, 1:-1, 1:-1] = _u_rows(
-            c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *args)
-    o_ref[0:1, 1:-1, 1:-1] = _u_rows(p_ref[:], c[0:1], c[1:2], a[0:1], *args)
-    o_ref[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
-        c[bx - 2:bx - 1], c[bx - 1:bx], n_ref[:], a[bx - 1:bx], *args)
-
-    # y wrap from the updated interior (y halo = alias of inner plane):
-    o_ref[:, 0:1, 1:-1] = o_ref[:, S1 - 2:S1 - 1, 1:-1]
-    o_ref[:, S1 - 1:S1, 1:-1] = o_ref[:, 1:2, 1:-1]
-    # z wrap from the y-wrapped result (sequential-dimension order):
-    o_ref[:, :, 0:1] = o_ref[:, :, S2 - 2:S2 - 1]
-    o_ref[:, :, S2 - 1:S2] = o_ref[:, :, 1:2]
-
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        o_ref[0:1] = xf_ref[:]
-
-    @pl.when(i == nb - 1)
-    def _():
-        o_ref[bx - 1:bx] = xl_ref[:]
-
-
-def _kernel(c_ref, p_ref, n_ref, a_ref, rxf_ref, rxl_ref, ryf_ref, ryl_ref,
-            rzf_ref, rzl_ref, o_ref, oy_lo_ref, oy_hi_ref, oz_lo_ref,
-            oz_hi_ref, *, rdx2, rdy2, rdz2, bx, nb):
-    """One x-slab: interior stencil update + in-VMEM halo-plane assembly,
-    plus the output's y/z boundary slabs as compact extra outputs (consumed
-    by the slab-carry loop of :func:`fused_diffusion_steps`).
+def _make_kernel(wrap_y: bool, wrap_z: bool, scal, bx: int, nb: int):
+    """Kernel factory: one x-slab program with per-dimension halo modes.
 
     Assembly order realizes the reference's sequential-dimension semantics
     (`/root/reference/src/update_halo.jl:36,130`): x halo planes first, then
     y rows, then z columns — later dimensions own the shared corner/edge
-    cells, exactly like `igg.halo.assemble_planes`.  No extended-slab
-    concatenate: the update is written in three x-row bands whose outer rows
-    take their x-neighbor from the single-plane `p`/`n` refs."""
+    cells, exactly like `igg.halo.assemble_planes`.  Per dimension:
+
+      - x: always plane inputs (they cross program boundaries) — received
+        planes on multi-device x, the self-swapped send planes on a single
+        periodic device;
+      - y/z `wrap` mode (single periodic device along the dim — the
+        reference's self-neighbor path,
+        `/root/reference/src/update_halo.jl:516-532`): the halo is an
+        in-VMEM alias of the updated inner plane.  No (S0,S1,1)-shaped
+        z-plane arrays — whose minor-dim lane padding makes their HBM I/O
+        cost ~40x their logical size — ever touch HBM, which is why 1-D/2-D
+        decompositions `(N,1,1)`/`(N,M,1)` are the recommended meshes;
+      - y/z `recv` mode: exchanged planes arrive as blocked inputs.
+
+    No extended-slab concatenate: the update is written in three x-row
+    bands whose outer rows take their x-neighbor from the single-plane
+    `p`/`n` refs.
+
+    Alias precision on periodic dims: wrap-mode halos are in-VMEM copies of
+    their aliased interiors (bitwise equal); x halo planes are computed by
+    XLA outside the kernel while their aliased interiors are computed by
+    Mosaic inside, so `T_new[0] == T_new[S0-2]` holds to 1 ulp, not bitwise
+    (measured max diff 1.5e-8 f32 on v5e; `tests/test_alias_invariant.py`).
+    """
     from jax.experimental import pallas as pl
 
-    S1, S2 = c_ref.shape[1], c_ref.shape[2]
-    c = c_ref[:]
-    a = a_ref[:]
-    args = (rdx2, rdy2, rdz2)
-    if bx > 2:
-        o_ref[1:bx - 1, 1:-1, 1:-1] = _u_rows(
-            c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *args)
-    o_ref[0:1, 1:-1, 1:-1] = _u_rows(p_ref[:], c[0:1], c[1:2], a[0:1], *args)
-    o_ref[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
-        c[bx - 2:bx - 1], c[bx - 1:bx], n_ref[:], a[bx - 1:bx], *args)
+    def kernel(*refs):
+        it = iter(refs)
+        c_ref, p_ref, n_ref, a_ref = next(it), next(it), next(it), next(it)
+        rxf_ref, rxl_ref = next(it), next(it)
+        ryf_ref = ryl_ref = rzf_ref = rzl_ref = None
+        if not wrap_y:
+            ryf_ref, ryl_ref = next(it), next(it)
+        if not wrap_z:
+            rzf_ref, rzl_ref = next(it), next(it)
+        o_ref = next(it)
+        oy_lo_ref = oy_hi_ref = oz_lo_ref = oz_hi_ref = None
+        if not wrap_y:
+            oy_lo_ref, oy_hi_ref = next(it), next(it)
+        if not wrap_z:
+            oz_lo_ref, oz_hi_ref = next(it), next(it)
 
-    i = pl.program_id(0)
+        S1, S2 = c_ref.shape[1], c_ref.shape[2]
+        c = c_ref[:]
+        a = a_ref[:]
+        if bx > 2:
+            o_ref[1:bx - 1, 1:-1, 1:-1] = _u_rows(
+                c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *scal)
+        o_ref[0:1, 1:-1, 1:-1] = _u_rows(p_ref[:], c[0:1], c[1:2],
+                                         a[0:1], *scal)
+        o_ref[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
+            c[bx - 2:bx - 1], c[bx - 1:bx], n_ref[:], a[bx - 1:bx], *scal)
 
-    # x halo planes: received planes land in the first/last programs' rows
-    # (their y/z edge cells are overwritten below — x loses the corners).
-    @pl.when(i == 0)
-    def _():
-        o_ref[0:1, 1:-1, 1:-1] = rxf_ref[:, 1:-1, 1:-1]
+        i = pl.program_id(0)
 
-    @pl.when(i == nb - 1)
-    def _():
-        o_ref[bx - 1:bx, 1:-1, 1:-1] = rxl_ref[:, 1:-1, 1:-1]
+        # x halo planes (interior region only; their y/z edge cells are
+        # owned by the later y/z writes below).
+        @pl.when(i == 0)
+        def _():
+            o_ref[0:1, 1:-1, 1:-1] = rxf_ref[:, 1:-1, 1:-1]
 
-    # y halo rows (full x extent; z edges overwritten below).
-    o_ref[:, 0:1, 1:-1] = ryf_ref[:, :, 1:-1]
-    o_ref[:, S1 - 1:S1, 1:-1] = ryl_ref[:, :, 1:-1]
-    # z halo columns (own all shared corners).
-    o_ref[:, :, 0:1] = rzf_ref[:]
-    o_ref[:, :, S2 - 1:S2] = rzl_ref[:]
+        @pl.when(i == nb - 1)
+        def _():
+            o_ref[bx - 1:bx, 1:-1, 1:-1] = rxl_ref[:, 1:-1, 1:-1]
 
-    # Boundary slabs of the assembled output, emitted compactly.
-    oy_lo_ref[:] = o_ref[:, 0:3, :]
-    oy_hi_ref[:] = o_ref[:, S1 - 3:S1, :]
-    oz_lo_ref[:] = o_ref[:, :, 0:3]
-    oz_hi_ref[:] = o_ref[:, :, S2 - 3:S2]
+        # y halo rows (full x extent; z edges overwritten below).
+        if wrap_y:
+            o_ref[:, 0:1, 1:-1] = o_ref[:, S1 - 2:S1 - 1, 1:-1]
+            o_ref[:, S1 - 1:S1, 1:-1] = o_ref[:, 1:2, 1:-1]
+        else:
+            o_ref[:, 0:1, 1:-1] = ryf_ref[:, :, 1:-1]
+            o_ref[:, S1 - 1:S1, 1:-1] = ryl_ref[:, :, 1:-1]
+        # z halo columns (own all shared corners).
+        if wrap_z:
+            o_ref[:, :, 0:1] = o_ref[:, :, S2 - 2:S2 - 1]
+            o_ref[:, :, S2 - 1:S2] = o_ref[:, :, 1:2]
+        else:
+            o_ref[:, :, 0:1] = rzf_ref[:]
+            o_ref[:, :, S2 - 1:S2] = rzl_ref[:]
+
+        # Boundary slabs of the assembled output for the recv-mode dims,
+        # emitted compactly (consumed by the slab-carry loop); wrap dims
+        # need no slabs — and the (S0,S1,3) z-slab would be lane-padded.
+        if not wrap_y:
+            oy_lo_ref[:] = o_ref[:, 0:3, :]
+            oy_hi_ref[:] = o_ref[:, S1 - 3:S1, :]
+        if not wrap_z:
+            oz_lo_ref[:] = o_ref[:, :, 0:3]
+            oz_hi_ref[:] = o_ref[:, :, S2 - 3:S2]
+
+    return kernel
 
 
 def _check_applicable(grid, s, bx):
@@ -234,25 +233,60 @@ def _check_applicable(grid, s, bx):
     return bx, dims_active
 
 
-def _call_kernel(T, A, recv, scal, bx, interpret):
-    """pallas_call plumbing: returns (out, ys_lo, ys_hi, zs_lo, zs_hi)."""
+def _wrap_set(wrap_yz):
+    """Dim indices handled by in-kernel wrap, for `exchange_all_dims`."""
+    return {d for d, w in zip((1, 2), wrap_yz) if w}
+
+
+def _wrap_dims(grid):
+    """Per-dimension halo modes for y/z: `wrap` when the dim is periodic
+    with a single device (the self-neighbor path handled in-VMEM).  x always
+    goes through the plane exchange — its planes cross program boundaries
+    anyway, and they are dense and cheap."""
+    return tuple(grid.dims[d] == 1 and bool(grid.periods[d])
+                 for d in (1, 2))
+
+
+def _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz):
+    """pallas_call plumbing: returns `(out, *slabs)` where `slabs` are the
+    boundary-slab outputs of the recv-mode dims only, in (y_lo, y_hi,
+    z_lo, z_hi) order — wrap dims emit none."""
     import jax
     from jax.experimental import pallas as pl
 
     s = T.shape
     S0, S1, S2 = s
     nb = S0 // bx
-    (rxf, rxl), (ryf, ryl), (rzf, rzl) = recv[0], recv[1], recv[2]
+    wy, wz = wrap_yz
+    rxf, rxl = recv[0]
 
-    kern = partial(_kernel, bx=bx, nb=nb, **scal)
+    scal_t = (scal["rdx2"], scal["rdy2"], scal["rdz2"])
+    kern = _make_kernel(wy, wz, scal_t, bx, nb)
     kwargs = {}
     if not interpret:
         from jax.experimental.pallas import tpu as pltpu
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024)
+
+    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    operands = [T, T, T, A, rxf, rxl]
+    in_specs = [
+        pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
+        pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
+        pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
+        plane_x,
+        plane_x,
+    ]
+    if not wy:
+        operands += list(recv[1])
+        in_specs += [pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0))] * 2
+    if not wz:
+        operands += list(recv[2])
+        in_specs += [pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0))] * 2
+
     # Under shard_map with varying-mesh-axes checking, out_shapes must carry
     # which axes the results vary over: the union of the operands'.
-    operands = (T, T, T, A, rxf, rxl, ryf, ryl, rzf, rzl)
     vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
     vma = frozenset().union(*[v for v in vmas if v])
 
@@ -260,32 +294,23 @@ def _call_kernel(T, A, recv, scal, bx, interpret):
         return (jax.ShapeDtypeStruct(dims, T.dtype, vma=vma) if vma
                 else jax.ShapeDtypeStruct(dims, T.dtype))
 
-    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    out_shape = [shp(S0, S1, S2)]
+    out_specs = [pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0))]
+    if not wy:
+        out_shape += [shp(S0, 3, S2)] * 2
+        out_specs += [pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0))] * 2
+    if not wz:
+        out_shape += [shp(S0, S1, 3)] * 2
+        out_specs += [pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0))] * 2
     return pl.pallas_call(
         kern,
-        out_shape=(shp(S0, S1, S2), shp(S0, 3, S2), shp(S0, 3, S2),
-                   shp(S0, S1, 3), shp(S0, S1, 3)),
+        out_shape=tuple(out_shape),
         grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
-            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
-            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-            plane_x,
-            plane_x,
-            pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0)),
-            pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0)),
-            pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0)),
-        ],
-        out_specs=(pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-                   pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0)),
-                   pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0)),
-                   pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0)),
-                   pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0))),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
         interpret=interpret,
         **kwargs,
-    )(T, T, T, A, rxf, rxl, ryf, ryl, rzf, rzl)
+    )(*operands)
 
 
 def _scal(dx, dy, dz):
@@ -301,125 +326,65 @@ def _self_wrap_all(grid) -> bool:
             and all(bool(p) for p in grid.periods))
 
 
-def _wrap_plane_yz(P):
-    """Periodic y/z halo rows/columns of a (1,S1,S2) plane whose interior
-    holds updated values: halo = alias of the updated inner plane, y first
-    then z (the sequential-dimension order)."""
-    import jax.numpy as jnp
-
-    S1, S2 = P.shape[1], P.shape[2]
-    P = jnp.concatenate([P[:, S1 - 2:S1 - 1, :], P[:, 1:S1 - 1, :],
-                         P[:, 1:2, :]], axis=1)
-    return jnp.concatenate([P[:, :, S2 - 2:S2 - 1], P[:, :, 1:S2 - 1],
-                            P[:, :, 1:2]], axis=2)
-
-
-def _call_kernel_wrap(T, A, scal, bx, interpret):
-    """Self-wrap pallas_call: only the two precomputed wrapped x planes are
-    extra inputs; y/z halos assemble in VMEM.  Returns the updated block."""
-    import jax
-    from jax import lax
-    from jax.experimental import pallas as pl
-
-    s = T.shape
-    S0, S1, S2 = s
-    nb = S0 // bx
-
-    # T_new[0] = U[S0-2] / T_new[S0-1] = U[1], wrapped in y/z — from cheap
-    # contiguous 3-plane x-slabs.
-    xf = _wrap_plane_yz(_plane0(diffusion_compute(
-        lax.slice_in_dim(T, S0 - 3, S0, axis=0),
-        lax.slice_in_dim(A, S0 - 3, S0, axis=0), **scal)))
-    xl = _wrap_plane_yz(_plane0(diffusion_compute(
-        lax.slice_in_dim(T, 0, 3, axis=0),
-        lax.slice_in_dim(A, 0, 3, axis=0), **scal)))
-
-    kern = partial(_kernel_wrap, bx=bx, nb=nb, **scal)
-    kwargs = {}
-    if not interpret:
-        from jax.experimental.pallas import tpu as pltpu
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024)
-    operands = (T, A, xf, xl)
-    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
-    vma = frozenset().union(*[v for v in vmas if v])
-    out_shape = (jax.ShapeDtypeStruct(s, T.dtype, vma=vma) if vma
-                 else jax.ShapeDtypeStruct(s, T.dtype))
-    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
-    return pl.pallas_call(
-        kern,
-        out_shape=out_shape,
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx - 1) % S0, 0, 0)),
-            pl.BlockSpec((1, S1, S2), lambda i: ((i * bx + bx) % S0, 0, 0)),
-            pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-            plane_x,
-            plane_x,
-        ],
-        out_specs=pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
-        interpret=interpret,
-        **kwargs,
-    )(T, T, T, A, xf, xl)
-
-
-def _plane0(A):
-    """Center plane of a 3-plane x-slab."""
-    from jax import lax
-
-    return lax.slice_in_dim(A, 1, 2, axis=0)
-
-
-def _sends_and_stale(T, A_slabs, slabs, scal):
+def _sends_and_stale(T, a_slabs, slabs, scal, wrap_yz):
     """Send planes (updated inner planes `ol-1`/`s-ol`) from compact boundary
     slabs, plus stale (outermost) planes for open-boundary dims — no reads of
-    the big array beyond its two cheap contiguous x-end slabs."""
+    the big array beyond its two cheap contiguous x-end slabs.  Wrapped y/z
+    dims need neither sends nor slabs."""
     from jax import lax
 
     from ..halo import _plane
 
     s = T.shape
+    wy, wz = wrap_yz
     ys_lo, ys_hi, zs_lo, zs_hi = slabs
-    ax_lo, ax_hi, ay_lo, ay_hi, az_lo, az_hi = A_slabs
+    ax_lo, ax_hi, ay_lo, ay_hi, az_lo, az_hi = a_slabs
     xs_lo = lax.slice_in_dim(T, 0, 3, axis=0)          # contiguous: cheap
     xs_hi = lax.slice_in_dim(T, s[0] - 3, s[0], axis=0)
 
     send = {
         (0, 0): _plane(diffusion_compute(xs_lo, ax_lo, **scal), 0, 1),
         (0, 1): _plane(diffusion_compute(xs_hi, ax_hi, **scal), 0, 1),
-        (1, 0): _plane(diffusion_compute(ys_lo, ay_lo, **scal), 1, 1),
-        (1, 1): _plane(diffusion_compute(ys_hi, ay_hi, **scal), 1, 1),
-        (2, 0): _plane(diffusion_compute(zs_lo, az_lo, **scal), 2, 1),
-        (2, 1): _plane(diffusion_compute(zs_hi, az_hi, **scal), 2, 1),
     }
-    stale = {
-        (0, 0): xs_lo[0:1], (0, 1): xs_hi[2:3],
-        (1, 0): ys_lo[:, 0:1, :], (1, 1): ys_hi[:, 2:3, :],
-        (2, 0): zs_lo[:, :, 0:1], (2, 1): zs_hi[:, :, 2:3],
-    }
+    stale = {(0, 0): xs_lo[0:1], (0, 1): xs_hi[2:3]}
+    if not wy:
+        send[(1, 0)] = _plane(diffusion_compute(ys_lo, ay_lo, **scal), 1, 1)
+        send[(1, 1)] = _plane(diffusion_compute(ys_hi, ay_hi, **scal), 1, 1)
+        stale[(1, 0)] = ys_lo[:, 0:1, :]
+        stale[(1, 1)] = ys_hi[:, 2:3, :]
+    if not wz:
+        send[(2, 0)] = _plane(diffusion_compute(zs_lo, az_lo, **scal), 2, 1)
+        send[(2, 1)] = _plane(diffusion_compute(zs_hi, az_hi, **scal), 2, 1)
+        stale[(2, 0)] = zs_lo[:, :, 0:1]
+        stale[(2, 1)] = zs_hi[:, :, 2:3]
     return send, stale
 
 
-def _boundary_slabs(A):
-    """The four y/z 3-plane boundary slabs of a block (one-time strided
-    extraction; thereafter the kernel re-emits them compactly)."""
+def _boundary_slabs(A, wrap_yz):
+    """The y/z 3-plane boundary slabs of a block for the recv-mode dims
+    (one-time strided extraction; thereafter the kernel re-emits them
+    compactly); `None` placeholders for wrapped dims — the expensive
+    minor-dim slices are skipped entirely there."""
     from jax import lax
 
     s = A.shape
-    return (lax.slice_in_dim(A, 0, 3, axis=1),
-            lax.slice_in_dim(A, s[1] - 3, s[1], axis=1),
-            lax.slice_in_dim(A, 0, 3, axis=2),
-            lax.slice_in_dim(A, s[2] - 3, s[2], axis=2))
+    wy, wz = wrap_yz
+    ys = (None, None) if wy else (
+        lax.slice_in_dim(A, 0, 3, axis=1),
+        lax.slice_in_dim(A, s[1] - 3, s[1], axis=1))
+    zs = (None, None) if wz else (
+        lax.slice_in_dim(A, 0, 3, axis=2),
+        lax.slice_in_dim(A, s[2] - 3, s[2], axis=2))
+    return (*ys, *zs)
 
 
-def _coef_slabs(A):
+def _coef_slabs(A, wrap_yz):
     from jax import lax
 
     s = A.shape
     return (lax.slice_in_dim(A, 0, 3, axis=0),
             lax.slice_in_dim(A, s[0] - 3, s[0], axis=0),
-            *_boundary_slabs(A))
+            *_boundary_slabs(A, wrap_yz))
 
 
 def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
@@ -437,12 +402,13 @@ def fused_diffusion_step(T, Cp, *, dx, dy, dz, dt, lam, bx: int = 16,
     bx, dims_active = _check_applicable(grid, T.shape, bx)
     scal = _scal(dx, dy, dz)
     A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
-    if _self_wrap_all(grid):
-        return _call_kernel_wrap(T, A, scal, bx, interpret)
-    send, stale = _sends_and_stale(T, _coef_slabs(A), _boundary_slabs(T),
-                                   scal)
-    recv = exchange_all_dims(T, send, dims_active, grid, stale=stale)
-    return _call_kernel(T, A, recv, scal, bx, interpret)[0]
+    wrap_yz = _wrap_dims(grid)
+    send, stale = _sends_and_stale(T, _coef_slabs(A, wrap_yz),
+                                   _boundary_slabs(T, wrap_yz), scal,
+                                   wrap_yz)
+    recv = exchange_all_dims(T, send, dims_active, grid, stale=stale,
+                             wrap=_wrap_set(wrap_yz))
+    return _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz)[0]
 
 
 def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
@@ -450,8 +416,9 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
     """`n_inner` fused diffusion steps with boundary-slab carry (see module
     docstring): the y/z slabs feeding each step's send planes are emitted by
     the previous step's kernel, so the steady-state HBM traffic per step is
-    `T*(1 + 2/bx) + Cp + T_out` + a few MB of compact slab I/O.  Call inside
-    SPMD code; returns the advanced block."""
+    `T*(1 + 2/bx) + Cp + T_out` + a few MB of compact slab I/O.  Wrapped y/z
+    dims (single periodic device) skip sends, slabs, and carry entirely.
+    Call inside SPMD code; returns the advanced block."""
     from jax import lax
 
     from ..halo import exchange_all_dims
@@ -461,6 +428,7 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
     bx, dims_active = _check_applicable(grid, T.shape, bx)
     scal = _scal(dx, dy, dz)
     A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
+    wrap_yz = _wrap_dims(grid)
 
     if _self_wrap_all(grid):
         from .diffusion_mega import fused_diffusion_megasteps, mega_supported
@@ -470,20 +438,22 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
         if mega_supported(T.shape, bx, n_inner, interpret):
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal)
-        # Self-wrap per-step kernel: no slab carry needed — the only
-        # out-of-kernel work is two contiguous 3-plane x-slab stencils per
-        # step.
-        return lax.fori_loop(
-            0, n_inner,
-            lambda _, T: _call_kernel_wrap(T, A, scal, bx, interpret), T)
 
-    a_slabs = _coef_slabs(A)  # loop-invariant: sliced once
+    a_slabs = _coef_slabs(A, wrap_yz)  # loop-invariant: sliced once
+    init_slabs = _boundary_slabs(T, wrap_yz)
+    keep = [j for j, sl in enumerate(init_slabs) if sl is not None]
 
     def body(_, carry):
-        T, *slabs = carry
-        send, stale = _sends_and_stale(T, a_slabs, slabs, scal)
-        recv = exchange_all_dims(T, send, dims_active, grid, stale=stale)
-        return _call_kernel(T, A, recv, scal, bx, interpret)
+        T = carry[0]
+        slabs = [None] * 4
+        for pos, val in zip(keep, carry[1:]):
+            slabs[pos] = val
+        send, stale = _sends_and_stale(T, a_slabs, slabs, scal, wrap_yz)
+        recv = exchange_all_dims(T, send, dims_active, grid, stale=stale,
+                                 wrap=_wrap_set(wrap_yz))
+        # _call_kernel returns (out, *slabs-in-keep-order)
+        return _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz)
 
-    out = lax.fori_loop(0, n_inner, body, (T, *_boundary_slabs(T)))
+    out = lax.fori_loop(0, n_inner, body,
+                        (T, *(init_slabs[j] for j in keep)))
     return out[0]

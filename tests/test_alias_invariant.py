@@ -6,7 +6,7 @@ reference's halo copy guarantees this bitwise).  Measured behavior of the
 fused Pallas step on real TPU (v5e, 64x64x128 f32):
 
   - y/z planes: exact — they are in-VMEM copies of the interior planes
-    (`igg.ops.diffusion_pallas._kernel_wrap`);
+    (`igg.ops.diffusion_pallas._make_kernel`, wrap mode);
   - x planes: equal to 1 ulp (max |diff| 1.5e-8 f32) — the halo planes are
     computed by XLA outside the kernel while their aliased interiors are
     computed by Mosaic inside, and the two compilers contract FMAs

@@ -45,10 +45,12 @@ def test_mega_matches_per_step_kernel():
 
     out = fused_diffusion_megasteps(T, A, n_inner=6, bx=8, **scal)
 
-    from igg.ops.diffusion_pallas import _call_kernel_wrap
+    from igg.ops import fused_diffusion_step
     import jax
+    dt = params.timestep()
     ref = T
-    step = jax.jit(lambda T: _call_kernel_wrap(T, A, scal, 8, False))
+    step = jax.jit(lambda T: fused_diffusion_step(
+        T, Cp, dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam, bx=8))
     for _ in range(6):
         ref = step(ref)
     scale = float(jnp.max(jnp.abs(ref)))

@@ -126,6 +126,33 @@ def test_pallas_slab_carry_multi_step_matches_xla_path():
         igg.finalize_global_grid()
 
 
+def test_pallas_mixed_wrap_meshes_match_overlap_path():
+    """Per-dimension halo modes: dims with a single periodic device are
+    handled by in-VMEM wrap (no plane exchange), mixed with exchanged
+    dims — the practical 1-D/2-D decompositions `(N,1,1)`/`(N,M,1)`.
+    Must match the overlap-semantics XLA path on the 8-device CPU mesh."""
+    configs = [
+        # (N,M,1): z wrapped, x/y exchanged; mixed periodicity on x.
+        dict(dimx=4, dimy=2, dimz=1, periodz=1, periodx=1),
+        # (N,1,1): y and z wrapped, only x exchanged; open x boundary.
+        dict(dimx=8, dimy=1, dimz=1, periody=1, periodz=1),
+        # (1,M,1): x self-swapped, y exchanged, z wrapped.
+        dict(dimx=1, dimy=8, dimz=1, periodx=1, periody=1, periodz=1),
+    ]
+    for kw in configs:
+        igg.init_global_grid(8, 8, 128, quiet=True, **kw)
+        params = d3.Params(lx=4.0, ly=4.0, lz=60.0)
+        T, Cp = d3.init_fields(params, dtype=np.float32)
+        ref = d3.make_multi_step(3, params, donate=False, use_pallas=False,
+                                 overlap=True)
+        pal = d3.make_multi_step(3, params, donate=False, use_pallas=True,
+                                 pallas_interpret=True)
+        np.testing.assert_allclose(
+            np.array(pal(T, Cp)), np.array(ref(T, Cp)), rtol=2e-6,
+            atol=2e-5, err_msg=str(kw))
+        igg.finalize_global_grid()
+
+
 def test_pallas_gate_rejects_unsupported():
     igg.init_global_grid(6, 6, 6, quiet=True)  # local block too small
     params = d3.Params()
