@@ -196,7 +196,8 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
     for i, (d, ol) in enumerate(dims_active):
         if d in wrap:
             # Self-alias patch of every later pending plane: the wrapped
-            # halo rows along `d` are the plane's own inner rows.
+            # halo rows along `d` are the plane's own inner (send-position)
+            # rows `ol-1` / `s-ol`.
             for d2, ol2 in dims_active[i + 1:]:
                 if d2 in wrap:
                     continue
@@ -205,8 +206,8 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
                         P = store.get((d2, side2))
                         if P is None:
                             continue
-                        P = _put_plane(P, _plane(P, d, s[d] - 2), d, 0)
-                        P = _put_plane(P, _plane(P, d, 1), d, s[d] - 1)
+                        P = _put_plane(P, _plane(P, d, s[d] - ol), d, 0)
+                        P = _put_plane(P, _plane(P, d, ol - 1), d, s[d] - 1)
                         store[(d2, side2)] = P
             continue
         new_first, new_last = exchange_planes(
@@ -259,10 +260,9 @@ def _update_halo_field(A, grid):
 
     (When every active dimension is periodic with a single device and
     overlap 2, the update is algebraically `pad(interior, mode='wrap')`;
-    measured on TPU that only pays off when the *producer* of `A` skips its
-    own boundary assembly so the pad fuses into one pass — see
-    `igg.models.diffusion3d`'s wrap fast path — and regresses here, where
-    `A` arrives fully assembled.)"""
+    measured on TPU v5e that form does NOT fuse — it regressed both here
+    and as a model-level fast path, so the plane machinery below is used
+    everywhere.)"""
     s = A.shape
     dims = active_dims(s, grid)
     send = {}

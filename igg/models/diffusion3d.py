@@ -178,20 +178,7 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
     dt_lam = float(dt * lam)
 
     def xla_steps(T, Cp):
-        import jax.numpy as jnp
-
-        from igg.ops import diffusion_compute, diffusion_interior
-
-        grid = igg.get_global_grid()
-        # Fully-periodic single-device grid at overlap 2: compute+exchange
-        # is algebraically `pad(U, mode='wrap')` — the wrap IS the
-        # self-neighbor halo exchange, and it fuses with the stencil into
-        # one XLA pass (measured ~2x faster than plane-slices + masked
-        # assembly on TPU at 256^3).
-        wrap_fast = (tuple(grid.dims) == (1, 1, 1)
-                     and all(bool(p) for p in grid.periods)
-                     and grid.overlaps == (2, 2, 2)
-                     and T.ndim == 3 and T.shape == tuple(grid.nxyz))
+        from igg.ops import diffusion_compute
 
         # Loop-invariant coefficient: hoists the per-element divide out of
         # the time loop (same trick as the Pallas path).
@@ -200,9 +187,6 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
                                                 rdy2=rdy2, rdz2=rdz2)
 
         def one(T):
-            if wrap_fast:
-                U = diffusion_interior(T, A, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
-                return jnp.pad(U, 1, mode="wrap")
             if overlap:
                 return igg.hide_communication(T, comp, A)
             return igg.update_halo_local(comp(T, A))

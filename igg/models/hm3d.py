@@ -71,12 +71,15 @@ def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
     divq = ((qx[1:, :, :] - qx[:-1, :, :]) / dx
             + (qy[:, 1:, :] - qy[:, :-1, :]) / dy
             + (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
+    import jax.numpy as jnp
+
     inner = (slice(1, -1),) * 3
-    # fluid mass balance: Pe relaxes by Darcy flow + compaction closure
-    Pe = Pe.at[inner].add(dt * (-divq - Pe[inner] * phi[inner] / eta))
-    # compaction: porosity responds to effective pressure
-    phi = phi.at[inner].add(dt * (-phi[inner] * (1.0 - phi[inner])
-                                  * Pe[inner] / eta))
+    # Interior add as `A + zero-pad(delta)` — fuses, no dynamic-update-slice
+    # copy.  Fluid mass balance: Pe relaxes by Darcy flow + compaction
+    # closure; compaction: porosity responds to (updated) effective pressure.
+    Pe = Pe + jnp.pad(dt * (-divq - Pe[inner] * phi[inner] / eta), 1)
+    phi = phi + jnp.pad(dt * (-phi[inner] * (1.0 - phi[inner])
+                              * Pe[inner] / eta), 1)
     return Pe, phi
 
 

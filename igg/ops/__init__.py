@@ -8,11 +8,10 @@ maintenance so each time step touches HBM exactly once per array.
 
 from .diffusion_pallas import (
     diffusion_compute,
-    diffusion_interior,
     fused_diffusion_step,
     fused_diffusion_steps,
     pallas_supported,
 )
 
-__all__ = ["diffusion_compute", "diffusion_interior", "fused_diffusion_step",
+__all__ = ["diffusion_compute", "fused_diffusion_step",
            "fused_diffusion_steps", "pallas_supported"]

@@ -63,24 +63,6 @@ def pallas_supported(grid, T) -> bool:
     return s[0] % 4 == 0 and s[1] >= 8 and s[2] >= 128
 
 
-def diffusion_interior(T, A, *, rdx2, rdy2, rdz2):
-    """Interior 7-point-Laplacian update `U` of a 3-D block, one cell
-    smaller per side — no boundary assembly.  `A` is the precomputed
-    coefficient field `dt*lam/Cp` (loop-invariant; hoisting the division out
-    of the time loop).  Building the full-size result is the caller's
-    choice: masked-select stale boundaries (:func:`diffusion_compute`), or
-    `jnp.pad(U, 1, mode='wrap')` on fully-periodic single-device grids,
-    where the wrap IS the halo exchange (self-neighbor path,
-    `/root/reference/src/update_halo.jl:516-532`) and fuses with this
-    stencil into one XLA pass."""
-    ctr = T[1:-1, 1:-1, 1:-1]
-    lap = ((T[2:, 1:-1, 1:-1] + T[:-2, 1:-1, 1:-1]) * rdx2
-           + (T[1:-1, 2:, 1:-1] + T[1:-1, :-2, 1:-1]) * rdy2
-           + (T[1:-1, 1:-1, 2:] + T[1:-1, 1:-1, :-2]) * rdz2
-           - 2.0 * (rdx2 + rdy2 + rdz2) * ctr)
-    return ctr + A[1:-1, 1:-1, 1:-1] * lap
-
-
 def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):
     """The pure stencil update on an arbitrary 3-D block: conservative
     7-point-Laplacian interior update, boundary planes keep their stale
@@ -90,19 +72,17 @@ def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):
     Shift-invariant and radius-1, so it applies equally to full local blocks
     and to the 3-plane slabs that produce send planes."""
     import jax.numpy as jnp
-    from jax import lax
 
-    U = diffusion_interior(T, A, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
-    # Full-size assembly as a masked select (fuses into the same output pass;
-    # `.at[1:-1,...].add` would be a dynamic-update-slice that XLA turns into
-    # an extra full-array copy).
-    s = T.shape
-    inside = None
-    for d in range(3):
-        i = lax.broadcasted_iota(jnp.int32, s, d)
-        m = (i > 0) & (i < s[d] - 1)
-        inside = m if inside is None else inside & m
-    return jnp.where(inside, jnp.pad(U, 1), T)
+    # Full-size assembly as `T + zero-pad(delta)`: boundaries add exactly
+    # zero (the no-write semantics) and the pad fuses into the output pass.
+    # Measured faster than both the masked-select form (no iota mask chain)
+    # and `.at[1:-1,...].add` (a dynamic-update-slice XLA turns into an
+    # extra full-array copy).
+    lap = ((T[2:, 1:-1, 1:-1] + T[:-2, 1:-1, 1:-1]) * rdx2
+           + (T[1:-1, 2:, 1:-1] + T[1:-1, :-2, 1:-1]) * rdy2
+           + (T[1:-1, 1:-1, 2:] + T[1:-1, 1:-1, :-2]) * rdz2
+           - 2.0 * (rdx2 + rdy2 + rdz2) * T[1:-1, 1:-1, 1:-1])
+    return T + jnp.pad(A[1:-1, 1:-1, 1:-1] * lap, 1)
 
 
 def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
