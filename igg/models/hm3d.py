@@ -71,15 +71,14 @@ def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
     divq = ((qx[1:, :, :] - qx[:-1, :, :]) / dx
             + (qy[:, 1:, :] - qy[:, :-1, :]) / dy
             + (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
-    import jax.numpy as jnp
+    from igg.ops import interior_add
 
     inner = (slice(1, -1),) * 3
-    # Interior add as `A + zero-pad(delta)` — fuses, no dynamic-update-slice
-    # copy.  Fluid mass balance: Pe relaxes by Darcy flow + compaction
-    # closure; compaction: porosity responds to (updated) effective pressure.
-    Pe = Pe + jnp.pad(dt * (-divq - Pe[inner] * phi[inner] / eta), 1)
-    phi = phi + jnp.pad(dt * (-phi[inner] * (1.0 - phi[inner])
-                              * Pe[inner] / eta), 1)
+    # Fluid mass balance: Pe relaxes by Darcy flow + compaction closure;
+    # compaction: porosity responds to the (updated) effective pressure.
+    Pe = interior_add(Pe, dt * (-divq - Pe[inner] * phi[inner] / eta))
+    phi = interior_add(phi, dt * (-phi[inner] * (1.0 - phi[inner])
+                                  * Pe[inner] / eta))
     return Pe, phi
 
 

@@ -98,14 +98,11 @@ def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
           - (P[1:-1, 1:-1, 1:] - P[1:-1, 1:-1, :-1]) / dz
           + rho_face)                                    # buoyancy drives Vz
 
-    # Interior add as `V + zero-pad(delta)`: boundaries add exactly zero and
-    # the pad fuses into the output pass (`.at[1:-1,...].add` is a
-    # dynamic-update-slice that XLA turns into an extra full-array copy).
-    import jax.numpy as jnp
+    from igg.ops import interior_add
 
-    Vx = Vx + jnp.pad(dtV * rx, 1)
-    Vy = Vy + jnp.pad(dtV * ry, 1)
-    Vz = Vz + jnp.pad(dtV * rz, 1)
+    Vx = interior_add(Vx, dtV * rx)
+    Vy = interior_add(Vy, dtV * ry)
+    Vz = interior_add(Vz, dtV * rz)
     return P, Vx, Vy, Vz
 
 

@@ -10,8 +10,9 @@ from .diffusion_pallas import (
     diffusion_compute,
     fused_diffusion_step,
     fused_diffusion_steps,
+    interior_add,
     pallas_supported,
 )
 
 __all__ = ["diffusion_compute", "fused_diffusion_step",
-           "fused_diffusion_steps", "pallas_supported"]
+           "fused_diffusion_steps", "interior_add", "pallas_supported"]

@@ -63,6 +63,19 @@ def pallas_supported(grid, T) -> bool:
     return s[0] % 4 == 0 and s[1] >= 8 and s[2] >= 128
 
 
+def interior_add(A, delta, pad_width=1):
+    """`A.at[interior].add(delta)` expressed as `A + zero-pad(delta)`:
+    boundaries add exactly zero (the reference's no-write semantics) and
+    the pad fuses into the producing pass — `.at[...].add` is a
+    dynamic-update-slice that XLA turns into an extra full-array copy
+    (measured: removing three of them made the Stokes iteration 4.2x
+    faster on v5e).  `pad_width` follows `jnp.pad` (int or per-axis
+    pairs, e.g. `((1,1),(0,0))` for a dim-0-staggered 2-D field)."""
+    import jax.numpy as jnp
+
+    return A + jnp.pad(delta, pad_width)
+
+
 def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):
     """The pure stencil update on an arbitrary 3-D block: conservative
     7-point-Laplacian interior update, boundary planes keep their stale
@@ -71,18 +84,11 @@ def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):
     flux divergence re-associated — see `igg.models.diffusion3d.compute_step`).
     Shift-invariant and radius-1, so it applies equally to full local blocks
     and to the 3-plane slabs that produce send planes."""
-    import jax.numpy as jnp
-
-    # Full-size assembly as `T + zero-pad(delta)`: boundaries add exactly
-    # zero (the no-write semantics) and the pad fuses into the output pass.
-    # Measured faster than both the masked-select form (no iota mask chain)
-    # and `.at[1:-1,...].add` (a dynamic-update-slice XLA turns into an
-    # extra full-array copy).
     lap = ((T[2:, 1:-1, 1:-1] + T[:-2, 1:-1, 1:-1]) * rdx2
            + (T[1:-1, 2:, 1:-1] + T[1:-1, :-2, 1:-1]) * rdy2
            + (T[1:-1, 1:-1, 2:] + T[1:-1, 1:-1, :-2]) * rdz2
            - 2.0 * (rdx2 + rdy2 + rdz2) * T[1:-1, 1:-1, 1:-1])
-    return T + jnp.pad(A[1:-1, 1:-1, 1:-1] * lap, 1)
+    return interior_add(T, A[1:-1, 1:-1, 1:-1] * lap)
 
 
 def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
