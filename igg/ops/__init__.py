@@ -10,9 +10,9 @@ from .diffusion_pallas import (
     diffusion_compute,
     fused_diffusion_step,
     fused_diffusion_steps,
-    interior_add,
     pallas_supported,
 )
+from .stencil import interior_add
 
 __all__ = ["diffusion_compute", "fused_diffusion_step",
            "fused_diffusion_steps", "interior_add", "pallas_supported"]

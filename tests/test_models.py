@@ -172,3 +172,25 @@ def test_energy_conservation_periodic():
         T = step(T, Cp)
     e1 = float(np.sum(igg.gather_interior(Cp * T)))
     assert abs(e1 - e0) / abs(e0) < 1e-13
+
+
+def test_interior_add_matches_at_add():
+    """igg.ops.interior_add must be value-equivalent to `.at[interior].add`
+    for plain and per-axis (staggered) pad widths."""
+    import jax.numpy as jnp
+
+    from igg.ops import interior_add
+
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((6, 7, 6)))
+    d = jnp.asarray(rng.standard_normal((4, 5, 4)))
+    np.testing.assert_array_equal(
+        np.asarray(interior_add(A, d)),
+        np.asarray(A.at[1:-1, 1:-1, 1:-1].add(d)))
+    # staggered 2-D: pad only dim 0
+    B = jnp.asarray(rng.standard_normal((7, 6)))
+    e = jnp.asarray(rng.standard_normal((5, 6)))
+    np.testing.assert_array_equal(
+        np.asarray(interior_add(B, e, ((1, 1), (0, 0)))),
+        np.asarray(B.at[1:-1, :].add(e)))
