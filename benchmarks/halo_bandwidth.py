@@ -1,14 +1,27 @@
 """Effective halo-exchange bandwidth per chip — the BASELINE.json headline
 metric ("GB/s effective halo-exchange bandwidth per chip").
 
-Measures `update_halo` (the whole engine: pack slices -> ppermute/self-wrap ->
-unpack dynamic-update-slices, dimension-sequential) on a fully-periodic grid,
-for 1..N fields at once, amortized inside one XLA program per measurement.
+Measures `update_halo` (the whole engine: squeezed-plane pack -> grouped
+ppermute/self-wrap -> aligned-DUS or masked-select unpack, dimension-
+sequential) for 1..N fields at once, amortized inside one XLA program per
+measurement, on two halo sets:
+
+  - `xyz`: fully periodic 3-D — every dimension exchanges.  The lane (z)
+    dimension's halo tiles span 128/S of every tile row, so at S=256 this
+    update has a ~one-array-pass floor regardless of strategy (the engine's
+    single fused masked-select pass IS that floor; measured 160 us =
+    read+write of the block at HBM speed).  This is the TPU analog of the
+    reference's worst-strided dim-1 plane
+    (`/root/reference/src/update_halo.jl:439-462`).
+  - `xy`: x/y periodic, z open — the halo set of the *recommended*
+    `(N,M,1)` pod decompositions (z unsplit).  The engine's aligned-DUS
+    strategy updates only the boundary slabs in place (donated buffers);
+    measured ~19 us at 256^3 f32, ~8x round 2's engine.
 
 Accounting (stated so numbers are comparable across runs): per field and per
 participating dimension, every chip sends 2 boundary planes and receives 2 —
 `bytes_moved = fields * dims_active * 4 * plane_bytes`.  On a single chip the
-periodic exchange is the self-wrap path (pure HBM copies, the analog of the
+periodic exchange is the self-wrap path (pure HBM traffic, the analog of the
 reference's self-neighbor branch `/root/reference/src/update_halo.jl:516-532`);
 on a multi-chip mesh the planes ride the ICI links.
 
@@ -31,8 +44,13 @@ def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
     import igg
 
     grid = igg.get_global_grid()
-    fields = tuple(igg.zeros((n, n, n), dtype=dtype) + i
-                   for i in range(nfields))
+
+    def mkfields():
+        # Fresh arrays per measurement: the update donates its inputs, so a
+        # previous rep's fields are consumed buffers.
+        return tuple(igg.zeros((n, n, n), dtype=dtype) + i
+                     for i in range(nfields))
+
     spec = igg.spec_for(3)
 
     def body(*fs):
@@ -43,13 +61,16 @@ def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
 
     fn = jax.jit(jax.shard_map(body, mesh=grid.mesh,
                                in_specs=(spec,) * nfields,
-                               out_specs=(spec,) * nfields))
-    sec = median_of(lambda: time_dispatches(fn, fields, nt=nt)) / n_inner
+                               out_specs=(spec,) * nfields),
+                 donate_argnums=tuple(range(nfields)))
+    sec = median_of(lambda: time_dispatches(fn, mkfields(), nt=nt)) / n_inner
 
+    from igg.halo import active_dims, moving_dims
+    ndims = len(moving_dims(active_dims((n, n, n), grid), grid))
     itemsize = np.dtype(dtype).itemsize
     plane_bytes = n * n * itemsize
-    bytes_moved = nfields * 3 * 4 * plane_bytes  # 3 dims, 2 sides, send+recv
-    return sec, bytes_moved / sec / 1e9
+    bytes_moved = nfields * ndims * 4 * plane_bytes
+    return sec, bytes_moved / sec / 1e9, ndims
 
 
 def main():
@@ -62,29 +83,33 @@ def main():
     nt = int(sys.argv[2]) if len(sys.argv) > 2 else 5
     n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (200 if platform != "cpu" else 10)
 
-    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
-    grid = igg.get_global_grid()
-    note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} "
-         f"local={n}^3 n_inner={n_inner}")
-
     import jax.numpy as jnp
 
     # f16 on CPU (f64 needs jax_enable_x64); bf16 on accelerators.
     dtypes = (np.float32, np.float16 if platform == "cpu" else jnp.bfloat16)
-    for nfields in (1, 2, 4):
-        for dtype in dtypes:
-            sec, gbps = bench(n, nfields, dtype, nt=nt, n_inner=n_inner)
-            emit({
-                "metric": "halo_exchange_bandwidth_per_chip",
-                "value": round(gbps, 2),
-                "unit": "GB/s",
-                "config": {"local": n, "fields": nfields,
-                           "dtype": np.dtype(dtype).name,
-                           "devices": grid.nprocs, "dims": list(grid.dims),
-                           "platform": platform},
-                "us_per_update": round(sec * 1e6, 2),
-            })
-    igg.finalize_global_grid()
+    for halo_dims, periods in (("xyz", (1, 1, 1)), ("xy", (1, 1, 0))):
+        igg.init_global_grid(n, n, n, periodx=periods[0], periody=periods[1],
+                             periodz=periods[2], quiet=True)
+        grid = igg.get_global_grid()
+        note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} "
+             f"local={n}^3 halo_dims={halo_dims} n_inner={n_inner}")
+        for nfields in (1, 2, 4):
+            for dtype in dtypes:
+                sec, gbps, ndims = bench(n, nfields, dtype, nt=nt,
+                                         n_inner=n_inner)
+                emit({
+                    "metric": "halo_exchange_bandwidth_per_chip",
+                    "value": round(gbps, 2),
+                    "unit": "GB/s",
+                    "config": {"local": n, "fields": nfields,
+                               "dtype": np.dtype(dtype).name,
+                               "halo_dims": halo_dims, "ndims": ndims,
+                               "devices": grid.nprocs,
+                               "dims": list(grid.dims),
+                               "platform": platform},
+                    "us_per_update": round(sec * 1e6, 2),
+                })
+        igg.finalize_global_grid()
 
 
 if __name__ == "__main__":

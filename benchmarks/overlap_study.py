@@ -14,11 +14,20 @@ Models: `diffusion3d` (flagship, radius 1) and `stokes3d` (BASELINE config
 grid there is NO communication to hide (the exchange is HBM-local), so
 hidden-vs-plain measures pure restructuring overhead: ~0 for diffusion
 (radius-1, single-field slabs), substantial for Stokes (radius-2 slabs of
-five arrays, including minor-dim z-slabs).  The win appears where real
-collectives exist — on the 8-device mesh runs, hidden >= plain for both
-models (see overlap_study_mesh8.jsonl; smoke-flagged: CPU collectives, not
-ICI).  On real multi-chip TPU hardware the hidden variant is the intended
-configuration for Stokes; single-chip runs should use plain.
+five arrays, including minor-dim z-slabs).
+
+Honest reading of the committed artifacts (see results/*.jsonl): as of this
+round, `hidden` does NOT beat `plain` in ANY measured configuration — not
+on the single chip (no communication to hide, pure overhead) and not on the
+8-device virtual CPU mesh (in-process "collectives" are memcpys with
+nothing to overlap, and the slab recomputation contends for the same
+cores).  Neither environment exercises real ICI links, where XLA's
+latency-hiding scheduler can actually run the interior stencil while planes
+are in flight — the configuration `hide_communication` exists for — but no
+measurement demonstrating a win exists in this repo, and model defaults are
+therefore `overlap=False` everywhere.  Treat `hide_communication` as a
+correctness-complete mechanism whose performance case is unproven until a
+multi-chip TPU measurement lands.
 
 Usage: `python benchmarks/overlap_study.py [local_n] [nt] [n_inner]`.
 """

@@ -51,6 +51,21 @@ def main():
     # gitignored results_smoke/ so committed accelerator evidence is never
     # clobbered by a CPU run.
     res = (HERE / "results_smoke") if quick else None
+    if not quick and "--force-cpu-overwrite" not in sys.argv:
+        # A full run on a machine without an accelerator would overwrite the
+        # committed TPU-measured artifacts with CPU smoke lines (stamped
+        # smoke=true, but the accelerator evidence would still be clobbered).
+        # Probe in a SUBPROCESS: initializing a backend here would hold the
+        # TPU client and break the benchmark children.
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True)
+        if probe.stdout.strip() == "cpu" or probe.returncode != 0:
+            print("run_all: no accelerator attached; refusing to overwrite "
+                  "committed results/. Use --quick (results_smoke/) or pass "
+                  "--force-cpu-overwrite.", file=sys.stderr)
+            sys.exit(2)
     import functools
     r = functools.partial(run, results=res)
     # Headline: the real accelerator (falls back to host CPU when none is

@@ -5,13 +5,49 @@ machinery (persistent send/recv buffer pools, pinned host memory, CUDA
 pack/unpack kernels, max-priority streams, MPI Isend/Irecv) collapses on TPU
 into a single XLA program per call signature:
 
-    pack   = lax.slice of the boundary plane          (fused by XLA)
-    send   = lax.ppermute shift along a mesh axis     (ICI collective-permute)
-    unpack = lax.dynamic_update_slice                 (fused by XLA)
+    pack   = plane slice of the boundary plane         (fused by XLA)
+    send   = lax.ppermute shift along a mesh axis      (ICI collective-permute)
+    unpack = aligned in-place slab updates, or one fused masked-select pass
 
 Halos never touch the host; buffer management is XLA's job (donated inputs
 make the update effectively in-place in HBM, matching the reference's
 mutate-in-place semantics with zero extra copies).
+
+**Plane representation (round 3).**  Internally, planes are rank-preserving
+lazy slices (size 1 along the exchanged dimension) patched in masked-select
+form, so the whole update stays in rank- and layout-homogeneous XLA fusions
+(handing XLA rank-2 planes makes its layout assignment transpose the
+surrounding fusions and pay whole-array relayout copies; a materialized
+keepdims `(S0,S1,1)` plane is lane-padded up to ~40x).  Planes are squeezed
+to dense 2-D arrays (the reference's `halosize(dim,A)` shape,
+`/root/reference/src/update_halo.jl:80`) only at the collective wire, where
+they must materialize anyway — so ppermute traffic and multi-field stacking
+move logical bytes, and nothing lane-padded ever reaches HBM or the ICI
+links.  Measured at 256^3 f32 on v5e, this plus the strategies below takes
+a 2-D-periodic update from 162 us to ~9-20 us.
+
+**Unpack strategies** (chosen per call signature by a static traffic model):
+  - *aligned-DUS*: per-dimension in-place updates — full planes along
+    untiled (major) dimensions, tile-aligned slab read-modify-writes along
+    the sublane/lane dimensions.  XLA performs these in place on donated
+    buffers; cost is a few MB instead of a full-array pass.  Used when every
+    participating dimension is tile-aligned and the summed slab traffic is
+    below the one-pass cost — in particular for the recommended `(N,M,1)`
+    decompositions, whose halo sets avoid the minor (lane) dimension.
+  - *masked-select*: ONE fused pass writing the whole block with received
+    planes selected in (`jnp.where` on `broadcasted_iota`), in dimension
+    order.  The lane dimension's halo tiles span `128/S` of every tile row,
+    so for small-to-medium local grids any z-active exchange costs ~a full
+    pass no matter how it is written; the single fused pass IS the floor
+    (measured 159 us at 256^3 f32 — one HBM read + write).
+
+The reference meets the same wall on GPUs — its maximally-strided dim-1
+plane gets a dedicated custom kernel (`/root/reference/src/update_halo.jl:
+439-462`); on TPU the tiled layout moves that worst case to the lane (minor)
+dimension, and the pack side of it is handled by a Pallas one-pass plane
+extractor (`igg.ops.pack`, used for multi-plane minor-dim sends where XLA
+materializes each plane in a separate relayout pass — measured 491 us vs
+92 us for the 4-plane y+z pack at 256^3).
 
 Preserved reference semantics:
   - exactly one boundary plane is exchanged per side per dimension:
@@ -35,7 +71,7 @@ Preserved reference semantics:
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import shared
 from .fields import spec_for
@@ -91,7 +127,64 @@ def check_fields(grid, fields, local_shapes) -> None:
 
 
 # ---------------------------------------------------------------------------
-# The exchange itself (operates on per-device local blocks)
+# Plane primitives
+#
+# Internally, planes are RANK-PRESERVING lazy slices (size 1 along the
+# exchanged dimension): every plane-consuming op is then rank- and
+# layout-homogeneous with the block, so XLA keeps the whole update in
+# default-layout fusions.  Handing XLA rank-2 (squeezed) plane arrays makes
+# its layout assignment pick transposed layouts for the surrounding fusions
+# and pay whole-array relayout copies each iteration (measured: 560 us
+# instead of 160 us at 256^3 f32).  Planes are squeezed ONLY at the
+# collective wire (see `_wire_exchange`) — a keepdims (S,S,1) array is
+# lane-padded up to ~40x on TPU, so the padded form must never be
+# materialized (and never ride the ICI links).
+# ---------------------------------------------------------------------------
+
+def _plane(A, d: int, i: int):
+    """Rank-preserving boundary plane (size 1 along `d`); the squeezed shape
+    is the reference's `halosize(dim,A)`
+    (`/root/reference/src/update_halo.jl:80`)."""
+    from jax import lax
+    return lax.slice_in_dim(A, i, i + 1, axis=d)
+
+
+def _put_row(P, row, axis: int, i: int):
+    """Row substitution in masked-select form rather than
+    dynamic-update-slice: the result stays a lazy elementwise expression
+    over `P` and `row`, so plane patches fuse into whatever consumes the
+    plane.  A DUS here forces the (possibly lazily-sliced) plane to
+    materialize, and materializing a minor-dim plane is a relayout pass
+    over the source tiles — measured ~90 us per plane pair at 256^3 f32,
+    turning a 160 us update into 560 us."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    idx = lax.broadcasted_iota(jnp.int32, P.shape, axis)
+    return jnp.where(idx == i, row, P)
+
+
+def active_dims(shape, grid) -> List[Tuple[int, int]]:
+    """The (dim, ol) pairs of a local block's shape that have a halo
+    (per-array staggered overlap `ol >= 2`,
+    `/root/reference/src/update_halo.jl:284`)."""
+    return [(d, grid.ol_of_local(d, shape))
+            for d in range(min(len(shape), NDIMS))
+            if grid.ol_of_local(d, shape) >= 2]
+
+
+def moving_dims(dims_active, grid) -> List[Tuple[int, int]]:
+    """The subset of `dims_active` along which halo planes actually change:
+    a dimension with one device and an open boundary never receives anything
+    (both global edges live on the same device — the reference's
+    `has_neighbor` returning false on both sides), so the verb-level update
+    can skip it entirely: the block already holds the stale planes."""
+    return [(d, ol) for d, ol in dims_active
+            if grid.dims[d] > 1 or grid.periods[d]]
+
+
+# ---------------------------------------------------------------------------
+# Exchange (operates on per-device squeezed planes)
 # ---------------------------------------------------------------------------
 
 def exchange_planes(left_send, right_send, stale_first, stale_last,
@@ -125,32 +218,81 @@ def exchange_planes(left_send, right_send, stale_first, stale_last,
             jnp.where(idx < n - 1, from_right, stale_last))
 
 
-def _plane(A, d: int, i: int):
-    from jax import lax
-    return lax.slice_in_dim(A, i, i + 1, axis=d)
+def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool):
+    """Exchange dim `d` for a group of same-plane-shape fields: planes are
+    SQUEEZED for the wire (dense logical bytes — the keepdims form is
+    lane-padded up to ~40x) and, for several fields, stacked so ONE
+    `ppermute` per side serves the whole group; received planes are
+    re-expanded to keepdims (a metadata reshape that fuses/cancels).
+    With one device along the axis nothing materializes — the lazy keepdims
+    planes pass straight through (self-neighbor/no-write paths)."""
+    import jax.numpy as jnp
+
+    if n == 1:
+        return [exchange_planes(sends[i][(d, 0)], sends[i][(d, 1)],
+                                stales[i][(d, 0)], stales[i][(d, 1)],
+                                d, n, periodic)
+                for i in members]
+
+    def squeeze(P):
+        return None if P is None else jnp.squeeze(P, axis=d)
+
+    if len(members) == 1:
+        i = members[0]
+        nf_, nl_ = exchange_planes(
+            squeeze(sends[i][(d, 0)]), squeeze(sends[i][(d, 1)]),
+            squeeze(stales[i][(d, 0)]), squeeze(stales[i][(d, 1)]),
+            d, n, periodic)
+        return [(jnp.expand_dims(nf_, d), jnp.expand_dims(nl_, d))]
+
+    ls = jnp.stack([squeeze(sends[i][(d, 0)]) for i in members])
+    rs = jnp.stack([squeeze(sends[i][(d, 1)]) for i in members])
+    if periodic:
+        sf = sl = None
+    else:
+        sf = jnp.stack([squeeze(stales[i][(d, 0)]) for i in members])
+        sl = jnp.stack([squeeze(stales[i][(d, 1)]) for i in members])
+    nf_, nl_ = exchange_planes(ls, rs, sf, sl, d, n, periodic)
+    return [(jnp.expand_dims(nf_[k], d), jnp.expand_dims(nl_[k], d))
+            for k in range(len(members))]
 
 
-def _put_plane(A, P, d: int, i: int):
-    from jax import lax
-    return lax.dynamic_update_slice_in_dim(A, P, i, axis=d)
-
-
-def active_dims(shape, grid) -> List[Tuple[int, int]]:
-    """The (dim, ol) pairs of a local block's shape that have a halo
-    (per-array staggered overlap `ol >= 2`,
-    `/root/reference/src/update_halo.jl:284`)."""
-    return [(d, grid.ol_of_local(d, shape))
-            for d in range(min(len(shape), NDIMS))
-            if grid.ol_of_local(d, shape) >= 2]
+def _patch_pending(store, key, d: int, s, val_first, val_last, pos: int):
+    """Overwrite the edge rows along exchanged dimension `d` of a pending
+    plane of a *later* dimension `d2 = key[0]` (`d < d2`) with the received
+    planes' values at that plane's position `pos` — the sequential
+    corner/edge propagation of `/root/reference/src/update_halo.jl:36,130`.
+    All keepdims: the patch rows are size 1 along both `d` and `d2`."""
+    P = store.get(key)
+    if P is None:
+        return
+    d2 = key[0]
+    P = _put_row(P, _plane(val_first, d2, pos), d, 0)
+    P = _put_row(P, _plane(val_last, d2, pos), d, s[d] - 1)
+    store[key] = P
 
 
 def exchange_all_dims(A, send: Dict, dims_active, grid,
                       stale: Dict = None, wrap=()) -> Dict:
-    """Dimension-sequential plane-level exchange with corner/edge propagation.
+    """Dimension-sequential plane-level exchange with corner/edge propagation
+    for ONE field.  `send[(d, side)]` are the packed KEEPDIMS send planes
+    (size 1 along `d`; squeezing for the collective wire is internal);
+    returns `recv[d] = (new_first, new_last)` keepdims halo planes per
+    active dimension.  See :func:`exchange_all_dims_grouped` for the
+    semantics; this wrapper is the single-field form used by the fused
+    kernels and :func:`igg.hide_communication`."""
+    recvs = exchange_all_dims_grouped(
+        [A.shape], [send], [dims_active], grid,
+        stales=[stale], wraps=[wrap], blocks=[A])
+    return recvs[0]
 
-    `send[(d, side)]` are the packed send planes (already containing whatever
-    values the caller's semantics require at pack time).  Returns
-    `recv[d] = (new_first_plane, new_last_plane)` per active dimension.
+
+def exchange_all_dims_grouped(shapes, sends, dims_actives, grid,
+                              stales=None, wraps=None,
+                              blocks=None) -> List[Dict]:
+    """Dimension-sequential plane exchange for several fields at once, with
+    corner/edge propagation.  All planes in and out are KEEPDIMS (size 1
+    along their dimension); squeezing happens only on the collective wire.
 
     Equivalence with the reference's sequential per-dimension update of the
     full array (`/root/reference/src/update_halo.jl:36,130`): what later
@@ -162,123 +304,242 @@ def exchange_all_dims(A, send: Dict, dims_active, grid,
     dimension order (later dimensions win the shared corner/edge cells, like
     the reference's later exchanges overwrite them).
 
-    Dims in `wrap` (single periodic device, halo assembled by the caller —
-    e.g. in-VMEM by the fused Pallas kernel) are not exchanged and need no
-    send planes; their contribution to the sequential semantics is the
-    self-alias patch: later dims' pending planes get the wrapped halo rows,
-    which are aliases of the plane's own inner rows.
+    Dims in a field's `wrap` set (single periodic device, halo assembled by
+    the caller — e.g. in-VMEM by the fused Pallas kernel) are not exchanged
+    and need no send planes; their contribution to the sequential semantics
+    is the self-alias patch: later dims' pending planes get the wrapped halo
+    rows, which are aliases of the plane's own inner rows.
 
-    Shared by :func:`igg.update_halo` / :func:`igg.update_halo_local` (send
-    planes sliced from the block), :func:`igg.hide_communication` (send
-    planes from thin slab recomputations), and the fused Pallas path (send
-    planes from carried boundary slabs, wrap dims in-kernel).
+    Multi-field grouping: fields whose planes share a shape are exchanged
+    with ONE `ppermute` per (dim, side) — their planes squeezed for the wire
+    and stacked along a new leading axis (dense, so the stack moves logical
+    bytes only).  This is the TPU analog of the reference's grouped-call
+    pipelining note (`/root/reference/src/update_halo.jl:19-20`) with the
+    collective count made independent of the field count.
+
+    `blocks[i]`, when given, supplies the source array for any stale planes
+    not already present in `stales[i]` (open-boundary fallbacks).
     """
-    s = A.shape
-    send = dict(send)
-    wrap = frozenset(wrap)
+    nf = len(shapes)
+    sends = [dict(s) for s in sends]
+    stales = [dict(st) if st else {} for st in (stales or [None] * nf)]
+    wraps = [frozenset(w or ()) for w in (wraps or [()] * nf)]
+
     # Stale planes: what an open-boundary edge device keeps (the reference's
     # no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
-    # Extracted only for non-periodic dims — periodic exchanges never read
-    # them, and a minor-dim plane slice costs nearly a full array pass on TPU
-    # (strided reads still transfer whole (8,128) tiles).  Callers holding
-    # the boundary planes in compact form already (e.g. the slab-carried
-    # Pallas path) pass them via `stale` to skip the slicing cost.
-    stale = dict(stale) if stale else {}
-    for d, ol in dims_active:
-        if d in wrap or grid.periods[d]:
-            stale[(d, 0)] = stale[(d, 1)] = None
-        else:
-            for side, i in ((0, 0), (1, s[d] - 1)):
-                if (d, side) not in stale:
-                    stale[(d, side)] = _plane(A, d, i)
+    # Extracted lazily from the block only for non-periodic dims — periodic
+    # exchanges never read them.
+    for i in range(nf):
+        s = shapes[i]
+        for d, ol in dims_actives[i]:
+            if d in wraps[i] or grid.periods[d]:
+                stales[i][(d, 0)] = stales[i][(d, 1)] = None
+            else:
+                for side, pos in ((0, 0), (1, s[d] - 1)):
+                    if (d, side) not in stales[i]:
+                        stales[i][(d, side)] = _plane(blocks[i], d, pos)
 
-    recv: Dict[int, Tuple] = {}
-    for i, (d, ol) in enumerate(dims_active):
-        if d in wrap:
-            # Self-alias patch of every later pending plane: the wrapped
-            # halo rows along `d` are the plane's own inner (send-position)
-            # rows `ol-1` / `s-ol`.
-            for d2, ol2 in dims_active[i + 1:]:
-                if d2 in wrap:
-                    continue
+    all_dims = sorted({d for da in dims_actives for d, _ in da})
+    recvs: List[Dict] = [{} for _ in range(nf)]
+    for d in all_dims:
+        fidx = [i for i in range(nf) if d in [x for x, _ in dims_actives[i]]]
+        wrap_f = [i for i in fidx if d in wraps[i]]
+        exch_f = [i for i in fidx if d not in wraps[i]]
+
+        # Wrap dims (caller-assembled self-alias): patch every later pending
+        # plane's edge rows with the plane's own inner (send-position) rows.
+        for i in wrap_f:
+            s = shapes[i]
+            ol = dict(dims_actives[i])[d]
+            later = [d2 for d2, _ in dims_actives[i] if d2 > d
+                     and d2 not in wraps[i]]
+            for d2 in later:
                 for side2 in (0, 1):
-                    for store in (send, stale):
+                    for store in (sends[i], stales[i]):
                         P = store.get((d2, side2))
                         if P is None:
                             continue
-                        P = _put_plane(P, _plane(P, d, s[d] - ol), d, 0)
-                        P = _put_plane(P, _plane(P, d, ol - 1), d, s[d] - 1)
+                        P = _put_row(P, _plane(P, d, s[d] - ol), d, 0)
+                        P = _put_row(P, _plane(P, d, ol - 1), d, s[d] - 1)
                         store[(d2, side2)] = P
+
+        if not exch_f:
             continue
-        new_first, new_last = exchange_planes(
-            send[(d, 0)], send[(d, 1)], stale[(d, 0)], stale[(d, 1)],
-            d, grid.dims[d], bool(grid.periods[d]))
-        recv[d] = (new_first, new_last)
-        for d2, ol2 in dims_active[i + 1:]:
-            if d2 in wrap:
-                continue
-            for side2, p_send, p_stale in ((0, ol2 - 1, 0),
-                                           (1, s[d2] - ol2, s[d2] - 1)):
-                P = send[(d2, side2)]
-                P = _put_plane(P, _plane(new_first, d2, p_send), d, 0)
-                P = _put_plane(P, _plane(new_last, d2, p_send), d, s[d] - 1)
-                send[(d2, side2)] = P
-                if stale[(d2, side2)] is not None:
-                    Q = stale[(d2, side2)]
-                    Q = _put_plane(Q, _plane(new_first, d2, p_stale), d, 0)
-                    Q = _put_plane(Q, _plane(new_last, d2, p_stale), d, s[d] - 1)
-                    stale[(d2, side2)] = Q
-    return recv
+
+        # One collective per (dim, side) for all same-shaped planes
+        # (squeezed + stacked on the wire; see `_wire_exchange`).
+        n = grid.dims[d]
+        periodic = bool(grid.periods[d])
+        groups: Dict[tuple, List[int]] = {}
+        for i in exch_f:
+            P = sends[i][(d, 0)]
+            groups.setdefault((tuple(P.shape), str(P.dtype)), []).append(i)
+        for shape_key, members in groups.items():
+            per_field = _wire_exchange(members, sends, stales, d, n, periodic)
+            for i, (new_first, new_last) in zip(members, per_field):
+                recvs[i][d] = (new_first, new_last)
+                s = shapes[i]
+                for d2, ol2 in dims_actives[i]:
+                    if d2 <= d or d2 in wraps[i]:
+                        continue
+                    for side2, p_send, p_stale in ((0, ol2 - 1, 0),
+                                                   (1, s[d2] - ol2,
+                                                    s[d2] - 1)):
+                        _patch_pending(sends[i], (d2, side2), d, s,
+                                       new_first, new_last, p_send)
+                        _patch_pending(stales[i], (d2, side2), d, s,
+                                       new_first, new_last, p_stale)
+    return recvs
 
 
-def assemble_planes(out, recv: Dict, dims_active):
-    """Write the received halo planes into `out` in ONE fused masked-select
-    pass, in dimension order (later dimensions win the shared corner cells).
+# ---------------------------------------------------------------------------
+# Assembly
+# ---------------------------------------------------------------------------
 
-    Why not per-dimension `dynamic_update_slice` on the block (the direct
-    translation of the reference's in-place unpack,
-    `/root/reference/src/update_halo.jl:397-405`): XLA cannot prove the plane
-    reads and writes disjoint and materializes a full-array copy per
-    dimension — measured 3 full copies per update at 256^3 on TPU v5e.  The
-    masked-select chain fuses into a single read+write pass over the block;
-    all plane traffic on top is O(s^2)."""
+# Sublane tile height by itemsize (TPU (8,128)-class tiling; 16-bit packs two
+# values per sublane row pair, 8-bit four).
+_SUBLANE = {1: 32, 2: 16, 4: 8, 8: 8}
+_LANE = 128
+
+
+def _slab_sizes(shape, dtype) -> Dict[int, int]:
+    """Minimal tile-aligned in-place write granularity per dimension: 1 for
+    untiled (major) dims, the sublane tile for dim N-2, the lane tile for
+    dim N-1."""
+    import numpy as np
+
+    nd = len(shape)
+    ts = _SUBLANE.get(np.dtype(dtype).itemsize, 8)
+    out = {}
+    for d in range(nd):
+        if d == nd - 1:
+            out[d] = _LANE
+        elif d == nd - 2:
+            out[d] = ts
+        else:
+            out[d] = 1
+    return out
+
+
+def _assembly_plan(shape, dtype, dims) -> str:
+    """'dus' when every participating dimension admits a tile-aligned
+    in-place slab update (size a multiple of its tile and at least two
+    tiles), else 'select'.  Measured at 256^3: the two plans tie for f32
+    xyz (~165 us), DUS wins for bf16 xyz (138 vs 211 us) and wins big when
+    the lane dim does not participate (xy: 9-20 us vs a full pass), so DUS
+    is preferred whenever feasible; select is the fallback for small or
+    unaligned local shapes (e.g. the CPU-mesh test grids)."""
+    slabs = _slab_sizes(shape, dtype)
+    for d in dims:
+        t = slabs[d]
+        if t > 1 and (shape[d] % t != 0 or shape[d] < 2 * t):
+            return "select"
+    return "dus"
+
+
+def assemble_planes(out, recv: Dict, dims_active, plan: Optional[str] = None):
+    """Write the received (keepdims) halo planes into `out` in dimension
+    order (later dimensions win the shared corner cells), using the
+    aligned-DUS or masked-select strategy (module docstring).
+
+    Why masked-select instead of naive per-plane `dynamic_update_slice` (the
+    direct translation of the reference's in-place unpack,
+    `/root/reference/src/update_halo.jl:397-405`): an unaligned minor-dim
+    plane write makes XLA materialize a full-array copy per dimension —
+    measured 3 full copies per update at 256^3 on TPU v5e.  The masked-select
+    chain fuses into a single read+write pass; the aligned-DUS path goes
+    further and writes only the boundary slabs in place (donated buffers)."""
     import jax.numpy as jnp
     from jax import lax
 
     s = out.shape
-    for d, _ in dims_active:
-        idx = lax.broadcasted_iota(jnp.int32, s, d)
-        out = jnp.where(idx == 0, recv[d][0],
-                        jnp.where(idx == s[d] - 1, recv[d][1], out))
+    dims = [d for d, _ in dims_active]
+    if plan is None:
+        plan = _assembly_plan(s, out.dtype, dims)
+    if plan == "select":
+        for d in dims:
+            idx = lax.broadcasted_iota(jnp.int32, s, d)
+            out = jnp.where(idx == 0, recv[d][0],
+                            jnp.where(idx == s[d] - 1, recv[d][1], out))
+        return out
+
+    slabs = _slab_sizes(s, out.dtype)
+    for d in dims:
+        first, last = recv[d]
+        t = slabs[d]
+        if t == 1:
+            out = lax.dynamic_update_slice_in_dim(out, first, 0, axis=d)
+            out = lax.dynamic_update_slice_in_dim(out, last, s[d] - 1,
+                                                  axis=d)
+        else:
+            slab = lax.slice_in_dim(out, 0, t, axis=d)
+            idx = lax.broadcasted_iota(jnp.int32, slab.shape, d)
+            slab = jnp.where(idx == 0, first, slab)
+            out = lax.dynamic_update_slice_in_dim(out, slab, 0, axis=d)
+            slab = lax.slice_in_dim(out, s[d] - t, s[d], axis=d)
+            idx = lax.broadcasted_iota(jnp.int32, slab.shape, d)
+            slab = jnp.where(idx == t - 1, last, slab)
+            out = lax.dynamic_update_slice_in_dim(out, slab, s[d] - t,
+                                                  axis=d)
     return out
 
 
-def _update_halo_field(A, grid):
-    """Halo update of one field's local block: pack send planes (inner plane
-    `ol-1` / `s-ol`, `/root/reference/src/update_halo.jl:386-394`), exchange
-    dimension-sequentially with corner propagation, assemble in one pass.
+# ---------------------------------------------------------------------------
+# The update itself
+# ---------------------------------------------------------------------------
+
+def _is_tpu(grid) -> bool:
+    try:
+        return grid.mesh.devices.flat[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _update_halo_impl(fields: List, grid) -> Tuple:
+    """Halo update of all fields' local blocks: pack squeezed send planes
+    (inner plane `ol-1` / `s-ol`, `/root/reference/src/update_halo.jl:
+    386-394`), exchange dimension-sequentially with grouped collectives and
+    corner propagation, assemble per the static plan.
 
     (When every active dimension is periodic with a single device and
     overlap 2, the update is algebraically `pad(interior, mode='wrap')`;
     measured on TPU v5e that form does NOT fuse — it regressed both here
     and as a model-level fast path, so the plane machinery below is used
     everywhere.)"""
-    s = A.shape
-    dims = active_dims(s, grid)
-    send = {}
-    for d, ol in dims:
-        send[(d, 0)] = _plane(A, d, ol - 1)
-        send[(d, 1)] = _plane(A, d, s[d] - ol)
-    recv = exchange_all_dims(A, send, dims, grid)
-    return assemble_planes(A, recv, dims)
+    from .ops.pack import pack_planes_supported, pack_planes
 
+    use_pack = _is_tpu(grid)
+    shapes, sends, dims_moving = [], [], []
+    for A in fields:
+        s = A.shape
+        dims = moving_dims(active_dims(s, grid), grid)
+        plane_req = {}
+        for d, ol in dims:
+            plane_req[(d, 0)] = (d, ol - 1)
+            plane_req[(d, 1)] = (d, s[d] - ol)
+        send = {}
+        # Minor-dim planes that must materialize for a ppermute are extracted
+        # in ONE Pallas pass (XLA relayouts each separately — measured 491 us
+        # vs 92 us for the 4-plane y+z pack at 256^3 f32); everything else
+        # stays a lazy slice that fuses into its consumer.
+        minor = [k for k, (d, _) in plane_req.items()
+                 if grid.dims[d] > 1 and d >= A.ndim - 2 and A.ndim == 3]
+        if use_pack and len(minor) >= 2 and pack_planes_supported(s):
+            import jax.numpy as jnp
+            packed = pack_planes(A, [plane_req[k] for k in minor])
+            send.update({k: jnp.expand_dims(p, plane_req[k][0])
+                         for k, p in zip(minor, packed)})
+        for k, (d, pos) in plane_req.items():
+            if k not in send:
+                send[k] = _plane(A, d, pos)
+        shapes.append(s)
+        sends.append(send)
+        dims_moving.append(dims)
 
-def _update_halo_impl(fields: List, grid) -> Tuple:
-    """Halo update of all fields' local blocks.  Different fields are
-    independent, so XLA's scheduler can overlap their plane collectives — the
-    analog of the reference's grouped-call pipelining note
-    (`/root/reference/src/update_halo.jl:19-20`)."""
-    return tuple(_update_halo_field(A, grid) for A in fields)
+    recvs = exchange_all_dims_grouped(shapes, sends, dims_moving, grid,
+                                      blocks=fields)
+    return tuple(assemble_planes(A, recvs[i], dims_moving[i])
+                 for i, A in enumerate(fields))
 
 
 # ---------------------------------------------------------------------------
@@ -299,11 +560,12 @@ def update_halo(*fields):
     array(s) (functional counterpart of the reference's `update_halo!(A...)`,
     `/root/reference/src/update_halo.jl:23-28`).
 
-    Grouping several fields into one call compiles a single XLA program whose
-    collectives can be overlapped — group subsequent calls for performance,
-    exactly like the reference's performance note
-    (`/root/reference/src/update_halo.jl:19-20`).  Inputs are donated, so with
-    `T = igg.update_halo(T)` the update is in-place in device HBM.
+    Grouping several fields into one call compiles a single XLA program with
+    ONE collective per (dimension, side) for all same-shaped fields — group
+    subsequent calls for performance, exactly like the reference's
+    performance note (`/root/reference/src/update_halo.jl:19-20`).  Inputs
+    are donated, so with `T = igg.update_halo(T)` the update is in-place in
+    device HBM (and on tile-aligned grids touches only the boundary slabs).
     """
     import jax
 

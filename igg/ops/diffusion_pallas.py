@@ -37,6 +37,17 @@ output block's edge slabs, a few MB of dense writes), and the next
 iteration's send planes are computed from the carried slabs without touching
 the big array.  Cp's slabs are loop-invariant and sliced once.
 
+**Compact minor-dim representation (round 3).**  Halo planes travel as
+*squeezed* dense 2-D arrays (see `igg.halo`), and the carried z slabs are
+stored **transposed** — `(S0, 3, S1)` with z on the sublane axis — because a
+`(S0, S1, 3)` array is lane-padded to 128 on TPU (~42x its logical HBM
+footprint, per-step I/O measured at ~40x logical size in round 2).  The
+kernel emits the transposed slabs directly (an in-kernel lane extraction per
+plane), and their send planes are produced by applying the axis-symmetric
+stencil with swapped y/z coefficients, which yields the squeezed z plane
+`(S0, S1)` with no further transposition.  No lane-padded array of any kind
+touches HBM on this path.
+
 Because the send planes are recomputed rather than sliced from the kernel
 output, the exchange is data-independent of the main kernel; semantics match
 :func:`igg.hide_communication` exactly (identical to the plain sequential
@@ -139,6 +150,8 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, scal, bx: int, nb: int):
         if not wrap_z:
             oz_lo_ref, oz_hi_ref = next(it), next(it)
 
+        import jax.numpy as jnp
+
         S1, S2 = c_ref.shape[1], c_ref.shape[2]
         c = c_ref[:]
         a = a_ref[:]
@@ -152,40 +165,43 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, scal, bx: int, nb: int):
 
         i = pl.program_id(0)
 
-        # x halo planes (interior region only; their y/z edge cells are
-        # owned by the later y/z writes below).
+        # x halo planes (squeezed (S1,S2) inputs; interior region only —
+        # their y/z edge cells are owned by the later y/z writes below).
         @pl.when(i == 0)
         def _():
-            o_ref[0:1, 1:-1, 1:-1] = rxf_ref[:, 1:-1, 1:-1]
+            o_ref[0:1, 1:-1, 1:-1] = rxf_ref[1:-1, 1:-1][None]
 
         @pl.when(i == nb - 1)
         def _():
-            o_ref[bx - 1:bx, 1:-1, 1:-1] = rxl_ref[:, 1:-1, 1:-1]
+            o_ref[bx - 1:bx, 1:-1, 1:-1] = rxl_ref[1:-1, 1:-1][None]
 
         # y halo rows (full x extent; z edges overwritten below).
         if wrap_y:
             o_ref[:, 0:1, 1:-1] = o_ref[:, S1 - 2:S1 - 1, 1:-1]
             o_ref[:, S1 - 1:S1, 1:-1] = o_ref[:, 1:2, 1:-1]
         else:
-            o_ref[:, 0:1, 1:-1] = ryf_ref[:, :, 1:-1]
-            o_ref[:, S1 - 1:S1, 1:-1] = ryl_ref[:, :, 1:-1]
-        # z halo columns (own all shared corners).
+            o_ref[:, 0:1, 1:-1] = jnp.expand_dims(ryf_ref[:, 1:-1], 1)
+            o_ref[:, S1 - 1:S1, 1:-1] = jnp.expand_dims(ryl_ref[:, 1:-1], 1)
+        # z halo columns (own all shared corners).  The squeezed (bx,S1)
+        # plane is transposed onto the sublane axis in-register.
         if wrap_z:
             o_ref[:, :, 0:1] = o_ref[:, :, S2 - 2:S2 - 1]
             o_ref[:, :, S2 - 1:S2] = o_ref[:, :, 1:2]
         else:
-            o_ref[:, :, 0:1] = rzf_ref[:]
-            o_ref[:, :, S2 - 1:S2] = rzl_ref[:]
+            o_ref[:, :, 0:1] = jnp.expand_dims(rzf_ref[:], 2)
+            o_ref[:, :, S2 - 1:S2] = jnp.expand_dims(rzl_ref[:], 2)
 
         # Boundary slabs of the assembled output for the recv-mode dims,
         # emitted compactly (consumed by the slab-carry loop); wrap dims
-        # need no slabs — and the (S0,S1,3) z-slab would be lane-padded.
+        # need no slabs.  z slabs are emitted TRANSPOSED (bx,3,S1) — the
+        # natural (bx,S1,3) form would be lane-padded ~42x in HBM.
         if not wrap_y:
             oy_lo_ref[:] = o_ref[:, 0:3, :]
             oy_hi_ref[:] = o_ref[:, S1 - 3:S1, :]
         if not wrap_z:
-            oz_lo_ref[:] = o_ref[:, :, 0:3]
-            oz_hi_ref[:] = o_ref[:, :, S2 - 3:S2]
+            for j in range(3):
+                oz_lo_ref[:, j, :] = o_ref[:, :, j]
+                oz_hi_ref[:, j, :] = o_ref[:, :, S2 - 3 + j]
 
     return kernel
 
@@ -225,14 +241,19 @@ def _wrap_dims(grid):
 def _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz):
     """pallas_call plumbing: returns `(out, *slabs)` where `slabs` are the
     boundary-slab outputs of the recv-mode dims only, in (y_lo, y_hi,
-    z_lo, z_hi) order — wrap dims emit none."""
+    z_lo, z_hi) order — wrap dims emit none.  The engine's keepdims recv
+    planes are squeezed at this boundary (dense 2-D kernel operands; for
+    wire-materialized planes the expand/squeeze pair cancels)."""
     import jax
+    import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     s = T.shape
     S0, S1, S2 = s
     nb = S0 // bx
     wy, wz = wrap_yz
+    recv = {d: (jnp.squeeze(a, d), jnp.squeeze(b, d))
+            for d, (a, b) in recv.items()}
     rxf, rxl = recv[0]
 
     scal_t = (scal["rdx2"], scal["rdy2"], scal["rdz2"])
@@ -243,7 +264,7 @@ def _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz):
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024)
 
-    plane_x = pl.BlockSpec((1, S1, S2), lambda i: (0, 0, 0))
+    plane_x = pl.BlockSpec((S1, S2), lambda i: (0, 0))
     operands = [T, T, T, A, rxf, rxl]
     in_specs = [
         pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0)),
@@ -255,10 +276,10 @@ def _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz):
     ]
     if not wy:
         operands += list(recv[1])
-        in_specs += [pl.BlockSpec((bx, 1, S2), lambda i: (i, 0, 0))] * 2
+        in_specs += [pl.BlockSpec((bx, S2), lambda i: (i, 0))] * 2
     if not wz:
         operands += list(recv[2])
-        in_specs += [pl.BlockSpec((bx, S1, 1), lambda i: (i, 0, 0))] * 2
+        in_specs += [pl.BlockSpec((bx, S1), lambda i: (i, 0))] * 2
 
     # Under shard_map with varying-mesh-axes checking, out_shapes must carry
     # which axes the results vary over: the union of the operands'.
@@ -275,8 +296,8 @@ def _call_kernel(T, A, recv, scal, bx, interpret, wrap_yz):
         out_shape += [shp(S0, 3, S2)] * 2
         out_specs += [pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0))] * 2
     if not wz:
-        out_shape += [shp(S0, S1, 3)] * 2
-        out_specs += [pl.BlockSpec((bx, S1, 3), lambda i: (i, 0, 0))] * 2
+        out_shape += [shp(S0, 3, S1)] * 2   # transposed z slabs
+        out_specs += [pl.BlockSpec((bx, 3, S1), lambda i: (i, 0, 0))] * 2
     return pl.pallas_call(
         kern,
         out_shape=tuple(out_shape),
@@ -302,44 +323,57 @@ def _self_wrap_all(grid) -> bool:
 
 
 def _sends_and_stale(T, a_slabs, slabs, scal, wrap_yz):
-    """Send planes (updated inner planes `ol-1`/`s-ol`) from compact boundary
-    slabs, plus stale (outermost) planes for open-boundary dims — no reads of
-    the big array beyond its two cheap contiguous x-end slabs.  Wrapped y/z
-    dims need neither sends nor slabs."""
-    from jax import lax
+    """Squeezed send planes (updated inner planes `ol-1`/`s-ol`) from compact
+    boundary slabs, plus stale (outermost) planes for open-boundary dims — no
+    reads of the big array beyond its two cheap contiguous x-end slabs.
+    Wrapped y/z dims need neither sends nor slabs.
 
-    from ..halo import _plane
+    z slabs arrive TRANSPOSED (S0,3,S1): the stencil is axis-symmetric, so
+    applying it with swapped y/z coefficients produces the transposed update,
+    whose middle plane is exactly the squeezed z send plane (S0,S1)."""
+    from jax import lax
 
     s = T.shape
     wy, wz = wrap_yz
-    ys_lo, ys_hi, zs_lo, zs_hi = slabs
-    ax_lo, ax_hi, ay_lo, ay_hi, az_lo, az_hi = a_slabs
+    ys_lo, ys_hi, zt_lo, zt_hi = slabs
+    ax_lo, ax_hi, ay_lo, ay_hi, azt_lo, azt_hi = a_slabs
     xs_lo = lax.slice_in_dim(T, 0, 3, axis=0)          # contiguous: cheap
     xs_hi = lax.slice_in_dim(T, s[0] - 3, s[0], axis=0)
 
+    # Keepdims planes for the exchange engine (squeezed only on the wire /
+    # at the kernel boundary — see `igg.halo`); the lazy expand/squeeze
+    # pairs are metadata reshapes that cancel.
+    import jax.numpy as jnp
+
     send = {
-        (0, 0): _plane(diffusion_compute(xs_lo, ax_lo, **scal), 0, 1),
-        (0, 1): _plane(diffusion_compute(xs_hi, ax_hi, **scal), 0, 1),
+        (0, 0): diffusion_compute(xs_lo, ax_lo, **scal)[1:2],
+        (0, 1): diffusion_compute(xs_hi, ax_hi, **scal)[1:2],
     }
     stale = {(0, 0): xs_lo[0:1], (0, 1): xs_hi[2:3]}
     if not wy:
-        send[(1, 0)] = _plane(diffusion_compute(ys_lo, ay_lo, **scal), 1, 1)
-        send[(1, 1)] = _plane(diffusion_compute(ys_hi, ay_hi, **scal), 1, 1)
+        send[(1, 0)] = diffusion_compute(ys_lo, ay_lo, **scal)[:, 1:2, :]
+        send[(1, 1)] = diffusion_compute(ys_hi, ay_hi, **scal)[:, 1:2, :]
         stale[(1, 0)] = ys_lo[:, 0:1, :]
         stale[(1, 1)] = ys_hi[:, 2:3, :]
     if not wz:
-        send[(2, 0)] = _plane(diffusion_compute(zs_lo, az_lo, **scal), 2, 1)
-        send[(2, 1)] = _plane(diffusion_compute(zs_hi, az_hi, **scal), 2, 1)
-        stale[(2, 0)] = zs_lo[:, :, 0:1]
-        stale[(2, 1)] = zs_hi[:, :, 2:3]
+        swapped = dict(rdx2=scal["rdx2"], rdy2=scal["rdz2"],
+                       rdz2=scal["rdy2"])
+        send[(2, 0)] = jnp.expand_dims(
+            diffusion_compute(zt_lo, azt_lo, **swapped)[:, 1, :], 2)
+        send[(2, 1)] = jnp.expand_dims(
+            diffusion_compute(zt_hi, azt_hi, **swapped)[:, 1, :], 2)
+        stale[(2, 0)] = jnp.expand_dims(zt_lo[:, 0, :], 2)
+        stale[(2, 1)] = jnp.expand_dims(zt_hi[:, 2, :], 2)
     return send, stale
 
 
 def _boundary_slabs(A, wrap_yz):
     """The y/z 3-plane boundary slabs of a block for the recv-mode dims
     (one-time strided extraction; thereafter the kernel re-emits them
-    compactly); `None` placeholders for wrapped dims — the expensive
-    minor-dim slices are skipped entirely there."""
+    compactly, z TRANSPOSED to (S0,3,S1) to stay dense); `None` placeholders
+    for wrapped dims — the expensive minor-dim slices are skipped entirely
+    there."""
+    import jax.numpy as jnp
     from jax import lax
 
     s = A.shape
@@ -348,8 +382,8 @@ def _boundary_slabs(A, wrap_yz):
         lax.slice_in_dim(A, 0, 3, axis=1),
         lax.slice_in_dim(A, s[1] - 3, s[1], axis=1))
     zs = (None, None) if wz else (
-        lax.slice_in_dim(A, 0, 3, axis=2),
-        lax.slice_in_dim(A, s[2] - 3, s[2], axis=2))
+        jnp.swapaxes(lax.slice_in_dim(A, 0, 3, axis=2), 1, 2),
+        jnp.swapaxes(lax.slice_in_dim(A, s[2] - 3, s[2], axis=2), 1, 2))
     return (*ys, *zs)
 
 
