@@ -86,11 +86,17 @@ def study_stokes(n, nt, n_inner, platform):
     note(f"stokes3d platform={platform} devices={grid.nprocs} "
          f"dims={grid.dims} local={n}^3 (overlap 3)")
 
+    variants = [("plain", dict(overlap=False)), ("hidden", dict(overlap=True))]
+    from igg.ops import stokes_pallas_supported
+    import jax
+    P0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
+    if platform == "tpu" and stokes_pallas_supported(grid, P0):
+        variants.append(("pallas", dict(use_pallas=True)))
+
     times = {}
-    for name, ov in (("plain", False), ("hidden", True)):
+    for name, kv in variants:
         sec = median_of(lambda: stokes3d.run(nt, dtype=np.float32,
-                                             overlap=ov,
-                                             n_inner=n_inner)[1])
+                                             n_inner=n_inner, **kv)[1])
         times[name] = sec
         emit({
             "metric": f"stokes3d_iteration_{name}",

@@ -56,12 +56,13 @@ def init_fields(params: Params = Params(), dtype=np.float32):
     return P, Vx, Vy, Vz, Rho
 
 
-def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
-    """The pure coupled update (no halo exchange): pressure then velocities,
-    interior cells only — shift-invariant, so it applies both full-domain
-    and to the boundary slabs of :func:`igg.hide_communication`.  Effective
-    stencil radius is 2 (Gauss-Seidel flavor: the velocity updates read the
-    freshly-updated pressure, which itself reads velocities at +-1)."""
+def iteration_core(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
+    """The raw coupled arithmetic shared VERBATIM by the XLA path and the
+    fused Pallas kernel (`igg.ops.stokes_pallas`) — one source of truth, so
+    the two paths agree to Mosaic-vs-XLA rounding (~1 ulp).  Returns the
+    full-shape updated pressure and the *interior* velocity increments
+    `(P', rx, ry, rz)`; callers apply the increments with
+    :func:`igg.ops.interior_add` (XLA) or interior ref writes (kernel)."""
     # Divergence at cell centers
     divV = ((Vx[1:, :, :] - Vx[:-1, :, :]) / dx
             + (Vy[:, 1:, :] - Vy[:, :-1, :]) / dy
@@ -97,17 +98,28 @@ def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
           + (tyz[1:-1, 1:, :] - tyz[1:-1, :-1, :]) / dy
           - (P[1:-1, 1:-1, 1:] - P[1:-1, 1:-1, :-1]) / dz
           + rho_face)                                    # buoyancy drives Vz
+    return P, dtV * rx, dtV * ry, dtV * rz
 
+
+def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
+    """The pure coupled update (no halo exchange): pressure then velocities,
+    interior cells only — shift-invariant, so it applies both full-domain
+    and to the boundary slabs of :func:`igg.hide_communication`.  Effective
+    stencil radius is 2 (Gauss-Seidel flavor: the velocity updates read the
+    freshly-updated pressure, which itself reads velocities at +-1)."""
     from igg.ops import interior_add
 
-    Vx = interior_add(Vx, dtV * rx)
-    Vy = interior_add(Vy, dtV * ry)
-    Vz = interior_add(Vz, dtV * rz)
+    P, dVx, dVy, dVz = iteration_core(P, Vx, Vy, Vz, Rho, dx=dx, dy=dy,
+                                      dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+    Vx = interior_add(Vx, dVx)
+    Vy = interior_add(Vy, dVy)
+    Vz = interior_add(Vz, dVz)
     return P, Vx, Vy, Vz
 
 
 def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
-                    overlap: bool = False):
+                    overlap: bool = False, use_pallas: bool = False,
+                    pallas_interpret: bool = False):
     """One pseudo-transient iteration over per-device local arrays.
 
     With `overlap=False`: compute, then one grouped exchange for everything
@@ -117,8 +129,27 @@ def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
     form) so the exchanges are data-independent of the full-domain stencils;
     the radius-2 update chain requires a grid initialized with
     overlap >= 3 (BASELINE config 5: "Stokes solver with comm/compute
-    overlap")."""
+    overlap").  With `use_pallas=True` the whole iteration (compute + the
+    grouped halo update) runs as ONE fused kernel
+    (`igg.ops.fused_stokes_iteration`; self-wrap grids only)."""
     kw = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+    if use_pallas:
+        import jax.numpy as jnp
+
+        from igg.ops import fused_stokes_iteration, stokes_pallas_supported
+
+        grid = igg.get_global_grid()
+        platform_ok = (pallas_interpret or
+                       next(iter(grid.mesh.devices.flat)).platform == "tpu")
+        if (overlap or not platform_ok or P.dtype != jnp.float32
+                or not stokes_pallas_supported(grid, P)):
+            raise igg.GridError(
+                "the fused Stokes iteration requires TPU devices (or "
+                "pallas_interpret=True), a fully-periodic single-device "
+                "overlap-3 grid, f32 fields, x divisible by 8, and "
+                "overlap=False; use the XLA path otherwise.")
+        return fused_stokes_iteration(P, Vx, Vy, Vz, Rho, **kw,
+                                      interpret=pallas_interpret)
     if overlap:
         return igg.hide_communication(
             (P, Vx, Vy, Vz),
@@ -138,7 +169,8 @@ def _pseudo_steps(params: Params):
 
 
 def make_iteration(params: Params = Params(), *, donate: bool = True,
-                   overlap: bool = False, n_inner: int = 1):
+                   overlap: bool = False, n_inner: int = 1,
+                   use_pallas: bool = False, pallas_interpret: bool = False):
     """Compiled `(P, Vx, Vy, Vz, Rho) -> (P, Vx, Vy, Vz)` advancing
     `n_inner` iterations in one SPMD program."""
     from jax import lax
@@ -152,18 +184,24 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
             0, n_inner,
             lambda _, S: local_iteration(*S, Rho, dx=dx, dy=dy, dz=dz,
                                          mu=mu, dtP=dtP, dtV=dtV,
-                                         overlap=overlap),
+                                         overlap=overlap,
+                                         use_pallas=use_pallas,
+                                         pallas_interpret=pallas_interpret),
             (P, Vx, Vy, Vz))
 
-    return igg.sharded(it, donate_argnums=(0, 1, 2, 3) if donate else ())
+    # Interpret-mode pallas kernels under shard_map trip jax's vma checking
+    # on scalar constants (same workaround as diffusion3d.make_step).
+    return igg.sharded(it, donate_argnums=(0, 1, 2, 3) if donate else (),
+                       check_vma=not (use_pallas and pallas_interpret))
 
 
 def run(n_iters: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1):
+        overlap: bool = False, n_inner: int = 1, use_pallas: bool = False):
     """Slope-timed relaxation (see :func:`igg.time_steps`); returns fields
     and seconds/iteration."""
     P, Vx, Vy, Vz, Rho = init_fields(params, dtype=dtype)
-    it = make_iteration(params, overlap=overlap, n_inner=n_inner)
+    it = make_iteration(params, overlap=overlap, n_inner=n_inner,
+                        use_pallas=use_pallas)
     n1 = max(1, n_iters // 4)
     state, sec = igg.time_steps(
         lambda P, Vx, Vy, Vz, Rho: it(P, Vx, Vy, Vz, Rho) + (Rho,),

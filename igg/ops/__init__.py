@@ -13,6 +13,8 @@ from .diffusion_pallas import (
     pallas_supported,
 )
 from .stencil import interior_add
+from .stokes_pallas import fused_stokes_iteration, stokes_pallas_supported
 
 __all__ = ["diffusion_compute", "fused_diffusion_step",
-           "fused_diffusion_steps", "interior_add", "pallas_supported"]
+           "fused_diffusion_steps", "fused_stokes_iteration",
+           "interior_add", "pallas_supported", "stokes_pallas_supported"]

@@ -1,0 +1,251 @@
+"""Fused Pallas Stokes iteration (self-wrap single-device grids).
+
+One `pallas_call` performs a full pseudo-transient Stokes iteration —
+pressure update, six stresses, three momentum residuals, velocity updates,
+AND the grouped halo update of P/Vx/Vy/Vz — reading each field once and
+writing each updated field once.  The XLA composition
+(`stokes3d.local_iteration`: compute + `update_halo_local(P,Vx,Vy,Vz)`)
+costs ~0.27 ms/iter at 128^3 on v5e, of which the 4-field halo phase alone
+is ~0.26 ms measured in isolation (each field pays its own read+write
+assembly pass, plus XLA's multi-field layout copies); the fused kernel's
+traffic is the ideal 5 reads + 4 writes.
+
+This is the TPU re-expression of the reference's native-kernel performance
+tier (">10x faster" than the array-broadcast form,
+`/root/reference/README.md:161`) for BASELINE config 5's Stokes solver.
+
+Measured on v5e at 128^3 f32 (median-of-3, 100-iteration dispatches):
+**0.136 ms/iter** vs 0.269 for the XLA composition with the round-3 halo
+engine (2.0x) and 0.303 for round 2's (2.2x); matches the XLA path
+BITWISE on the chip (identical `iteration_core` arithmetic).  The DMA
+floor of this structure measured with a no-op core is 0.108 ms (~790 GB/s
+on ~85 MB/iter of traffic, including the 2x lane padding of Vz's
+(S,S,S+1) shape), so the remaining gap to ideal is non-overlapped VPU
+time.
+
+Structure (mirrors `diffusion_pallas`, radius-2 Gauss-Seidel variant):
+  - grid over x-slabs of `bx` rows; each program reads its slab plus 2 (3
+    for the x-staggered Vx) margin rows per side as single-row block refs
+    with modular index maps — edge programs read wrapped rows whose results
+    land only in halo rows that the halo phase overwrites;
+  - the slab arithmetic is LITERALLY `stokes3d.iteration_core` — one source
+    of truth with the XLA path, so the two agree to Mosaic-vs-XLA rounding;
+  - x halo planes cross program boundaries, so they are precomputed in XLA
+    from the two 5-row x-end windows (same `iteration_core`; contiguous
+    dim-0 slices, ~2 MB of reads) and written by the edge programs; y/z
+    halos are in-VMEM self-wrap aliases (each field's own staggered
+    overlap `ol`, reference `/root/reference/src/shared.jl:81`);
+  - Vx's extra global row `S0` lies outside the block grid; it is a halo
+    row (`Vx[S0] = Vx[ol-1]`) written by one cheap dim-0 DUS after the
+    kernel.
+
+Requirements: single device, all dimensions periodic (the reference's
+single-process fully-periodic configuration,
+`/root/reference/src/update_halo.jl:516-532`), overlap 3 everywhere (the
+radius-2 chain), float inputs of equal dtype.  Other configurations fall
+back to the XLA path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+# Deliberately TIGHT: the scoped-vmem budget steers Mosaic's scheduling, and
+# a small budget produces far better DMA/compute interleaving for this
+# kernel.  Swept on v5e at 128^3 (median-of-3, ms/iter): 20MB 0.138,
+# 26MB 0.137, 32MB 0.136, 44MB 0.139, 56MB 0.157, 64MB 0.175, 100MB 0.224,
+# 128MB 0.40.  The kernel's own working set fits comfortably below 20MB.
+_VMEM_LIMIT = 32 * 1024 * 1024
+
+
+def stokes_pallas_supported(grid, P) -> bool:
+    """Whether the fused iteration applies: self-wrap fully-periodic
+    single-device grid with overlap 3, unstaggered-pressure local block
+    large enough to slab."""
+    if tuple(grid.dims) != (1, 1, 1) or not all(bool(p) for p in grid.periods):
+        return False
+    if grid.overlaps != (3, 3, 3) or P.ndim != 3:
+        return False
+    s = tuple(grid.local_shape_any(P))
+    if s != tuple(grid.nxyz):
+        return False
+    return s[0] % 8 == 0 and s[0] >= 16 and s[1] >= 8 and s[2] >= 8
+
+
+def _windows(P, Vx, Vy, Vz, Rho, scal):
+    """The seven x-halo planes (and Vx's outside row) from the two 5-row
+    x-end windows, via `compute_iteration` on contiguous dim-0 slices."""
+    from jax import lax
+
+    from ..models.stokes3d import compute_iteration
+
+    S0 = P.shape[0]
+
+    def win(lo, hi):
+        cut = lambda A: lax.slice_in_dim(A, lo, hi, axis=0)
+        cutx = lambda A: lax.slice_in_dim(A, lo, hi + 1, axis=0)
+        return compute_iteration(cut(P), cutx(Vx), cut(Vy), cut(Vz),
+                                 cut(Rho), **scal)
+
+    Pw, Vxw, Vyw, Vzw = win(S0 - 5, S0)       # rows S0-5 .. S0-1 (cells)
+    first = (Pw[2], Vxw[2], Vyw[2], Vzw[2])   # global row S0-3 = s-ol
+    Pw, Vxw, Vyw, Vzw = win(0, 5)             # rows 0..4
+    last = (Pw[2], Vyw[2], Vzw[2])            # global row ol-1 = 2
+    vx_outside = Vxw[3]                       # Vx[S0] = Vx[ol_x-1] = Vx[3]
+    return first, last, vx_outside
+
+
+def _kernel(*refs, bx, nb, shapes, scal):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from ..models.stokes3d import iteration_core
+
+    it = iter(refs)
+    # Extended slabs: rows [a-1, a+bx+1) of each field (the x-staggered Vx
+    # one row more).  Minimal margins — out rows that would read beyond them
+    # are halo rows overwritten below.  Rho is read row-locally, so its
+    # margin rows are dummies taken from the center block (values unused).
+    m1, cP, p1 = next(it), next(it), next(it)
+    eP = jnp.concatenate([m1[:], cP[:], p1[:]], axis=0)
+    m1, cVx, p1, p2 = next(it), next(it), next(it), next(it)
+    eVx = jnp.concatenate([m1[:], cVx[:], p1[:], p2[:]], axis=0)
+    m1, cVy, p1 = next(it), next(it), next(it)
+    eVy = jnp.concatenate([m1[:], cVy[:], p1[:]], axis=0)
+    m1, cVz, p1 = next(it), next(it), next(it)
+    eVz = jnp.concatenate([m1[:], cVz[:], p1[:]], axis=0)
+    cRho = next(it)
+    r = cRho[:]
+    eRho = jnp.concatenate([r[0:1], r, r[0:1]], axis=0)
+    pf, vxf, vyf, vzf = (next(it) for _ in range(4))   # first planes
+    pl_, vyl, vzl = (next(it) for _ in range(3))       # last planes
+    oP, oVx, oVy, oVz = (next(it) for _ in range(4))
+
+    Pn, dVx, dVy, dVz = iteration_core(eP, eVx, eVy, eVz, eRho, **scal)
+
+    # Output rows j ↔ ext rows j+1; increments are on the ext interior
+    # (offset 1), so out row j ↔ increment row j.
+    oP[:] = Pn[1:1 + bx]
+    for o_ref, ext, dV in ((oVx, eVx, dVx), (oVy, eVy, dVy), (oVz, eVz, dVz)):
+        o_ref[:] = ext[1:1 + bx]
+        o_ref[:, 1:-1, 1:-1] = (ext[1:1 + bx, 1:-1, 1:-1]
+                                + dV[0:bx])
+
+    i = pl.program_id(0)
+
+    # x halo planes (dimension-sequential: x first, y/z own shared cells).
+    @pl.when(i == 0)
+    def _():
+        oP[0:1] = pf[:][None]
+        oVx[0:1] = vxf[:][None]
+        oVy[0:1] = vyf[:][None]
+        oVz[0:1] = vzf[:][None]
+
+    @pl.when(i == nb - 1)
+    def _():
+        oP[bx - 1:bx] = pl_[:][None]
+        oVy[bx - 1:bx] = vyl[:][None]
+        oVz[bx - 1:bx] = vzl[:][None]
+        # Vx's last halo row is global row S0, outside the block grid —
+        # written by the caller after the kernel.
+
+    # y then z self-wrap (per-field staggered ol: 4 on the staggered axis).
+    for o_ref, (_, sy, sz), oly, olz in (
+            (oP, shapes[0], 3, 3), (oVx, shapes[1], 3, 3),
+            (oVy, shapes[2], 4, 3), (oVz, shapes[3], 3, 4)):
+        o_ref[:, 0:1, :] = o_ref[:, sy - oly:sy - oly + 1, :]
+        o_ref[:, sy - 1:sy, :] = o_ref[:, oly - 1:oly, :]
+        o_ref[:, :, 0:1] = o_ref[:, :, sz - olz:sz - olz + 1]
+        o_ref[:, :, sz - 1:sz] = o_ref[:, :, olz - 1:olz]
+
+
+def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
+                           bx: int = 8, interpret: bool = False):
+    """One fused Stokes pseudo-transient iteration
+    `(P, Vx, Vy, Vz, Rho) -> (P', Vx', Vy', Vz')` with halo maintenance
+    included, on a self-wrap grid (see module docstring).  Matches
+    `stokes3d.local_iteration(..., overlap=False)` to Mosaic-vs-XLA
+    rounding."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    S0, S1, S2 = P.shape
+    while S0 % bx != 0:
+        bx //= 2
+    if bx < 4:
+        raise ValueError(f"x size {S0} not divisible into slabs of >= 4 rows")
+    nb = S0 // bx
+    scal = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+    shapes = [P.shape, Vx.shape, Vy.shape, Vz.shape, Rho.shape]
+
+    first, last, vx_outside = _windows(P, Vx, Vy, Vz, Rho, scal)
+
+    operands, in_specs = [], []
+    for F in (P, Vx, Vy, Vz, Rho):
+        sx = F.shape[0]
+        yz = F.shape[1:]
+        if F is Rho:
+            rows = ["c"]                    # row-local reads only
+        elif F is Vx:
+            rows = [-1, "c", bx, bx + 1]    # staggered: one extra top row
+        else:
+            rows = [-1, "c", bx]
+        for r in rows:
+            operands.append(F)
+            if r == "c":
+                in_specs.append(pl.BlockSpec((bx, *yz),
+                                             lambda i: (i, 0, 0)))
+            else:
+                in_specs.append(pl.BlockSpec(
+                    (1, *yz),
+                    lambda i, rr=r, ss=sx: ((i * bx + rr) % ss, 0, 0)))
+    for pln in (*first, *last):
+        operands.append(pln)
+        in_specs.append(pl.BlockSpec(pln.shape, lambda i: (0, 0)))
+
+    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
+    vma = frozenset().union(*[v for v in vmas if v])
+
+    def shp(dims, dt):
+        return (jax.ShapeDtypeStruct(dims, dt, vma=vma) if vma
+                else jax.ShapeDtypeStruct(dims, dt))
+
+    # Vx's out_shape is its full (S0+1) extent; the block grid covers rows
+    # [0, S0) and the caller writes row S0 below.
+    out_shape = [shp(F.shape, F.dtype) for F in (P, Vx, Vy, Vz)]
+    out_specs = [pl.BlockSpec((bx, *s[1:]), lambda i: (i, 0, 0))
+                 for s in (P.shape, Vx.shape, Vy.shape, Vz.shape)]
+
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT,
+            dimension_semantics=("parallel",))
+
+    Pn, Vxn, Vyn, Vzn = pl.pallas_call(
+        partial(_kernel, bx=bx, nb=nb, shapes=shapes[:4], scal=scal),
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(*operands)
+
+    # Vx's outside halo row (global S0): the sequential-dimension semantics
+    # give it the updated row `ol-1` with the y/z self-wraps applied on top
+    # (the later exchanges span the full x extent including this row).
+    def wrap_row(v, axis, size, ol):
+        idx = lax.broadcasted_iota(jnp.int32, v.shape, axis)
+        v = jnp.where(idx == 0, lax.slice_in_dim(v, size - ol, size - ol + 1,
+                                                 axis=axis), v)
+        return jnp.where(idx == size - 1,
+                         lax.slice_in_dim(v, ol - 1, ol, axis=axis), v)
+
+    vx_outside = wrap_row(vx_outside, 0, S1, 3)   # y
+    vx_outside = wrap_row(vx_outside, 1, S2, 3)   # z
+    Vxn = lax.dynamic_update_slice_in_dim(Vxn, vx_outside[None], S0, axis=0)
+    return Pn, Vxn, Vyn, Vzn

@@ -1,0 +1,126 @@
+"""Fused Pallas Stokes iteration vs the XLA composition (interpret mode).
+
+The compiled kernel matches the XLA path BITWISE on real TPU (checked in
+the benchmark path); interpret mode on CPU executes the same program
+structure and must agree to float32 rounding (the x-halo planes are
+recomputed from thin windows, so reassociation differences of ~1-2 ulp are
+expected — same contract as the diffusion kernel's alias invariant).
+"""
+
+import numpy as np
+import pytest
+
+import igg
+from igg.models import stokes3d
+
+
+@pytest.fixture
+def selfwrap_grid():
+    igg.init_global_grid(16, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    yield igg.get_global_grid()
+    igg.finalize_global_grid()
+
+
+def _fields(shapes_seed=0):
+    import jax.numpy as jnp
+
+    params = stokes3d.Params()
+    P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float32)
+    mk = lambda A, f, s: f(jnp.arange(A.size, dtype=np.float32)
+                           .reshape(A.shape) * s)
+    return (mk(P, jnp.sin, 1.0), mk(Vx, jnp.cos, 0.01),
+            mk(Vy, jnp.sin, 0.02), mk(Vz, jnp.cos, 0.03), Rho)
+
+
+def test_supported(selfwrap_grid):
+    from igg.ops import stokes_pallas_supported
+
+    import jax
+    P = jax.ShapeDtypeStruct((16, 8, 8), np.float32)
+    assert stokes_pallas_supported(selfwrap_grid, P)
+
+
+def test_not_supported_wrong_overlap():
+    from igg.ops import stokes_pallas_supported
+
+    import jax
+    igg.init_global_grid(16, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    P = jax.ShapeDtypeStruct((16, 8, 8), np.float32)
+    assert not stokes_pallas_supported(igg.get_global_grid(), P)
+    igg.finalize_global_grid()
+
+
+def test_not_supported_open_boundary():
+    from igg.ops import stokes_pallas_supported
+
+    import jax
+    igg.init_global_grid(16, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=0, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    P = jax.ShapeDtypeStruct((16, 8, 8), np.float32)
+    assert not stokes_pallas_supported(igg.get_global_grid(), P)
+    igg.finalize_global_grid()
+
+
+def test_use_pallas_on_unsupported_grid_raises():
+    igg.init_global_grid(16, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)  # ol 2
+    params = stokes3d.Params()
+    kw = stokes3d._pseudo_steps(params)
+    fields = _fields()
+    import pytest as _pytest
+    with _pytest.raises(igg.GridError, match="fused Stokes"):
+        stokes3d.local_iteration(*fields, **kw, use_pallas=True,
+                                 pallas_interpret=True)
+    igg.finalize_global_grid()
+
+
+def test_matches_xla_one_iteration(selfwrap_grid):
+    params = stokes3d.Params()
+    kw = stokes3d._pseudo_steps(params)
+    fields = _fields()
+    ref = stokes3d.local_iteration(*fields, **kw)
+    out = stokes3d.local_iteration(*fields, **kw, use_pallas=True,
+                                   pallas_interpret=True)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), ref, out):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-30)
+        assert rel < 1e-6, (name, rel)
+
+
+def test_make_iteration_pallas_through_sharded(selfwrap_grid):
+    """The compiled entry (igg.sharded / shard_map + fori_loop): interpret
+    kernels under shard_map need the check_vma workaround — this is the path
+    the benchmark and driver dryrun use."""
+    params = stokes3d.Params()
+    it_x = stokes3d.make_iteration(params, n_inner=2, donate=False)
+    it_p = stokes3d.make_iteration(params, n_inner=2, donate=False,
+                                   use_pallas=True, pallas_interpret=True)
+    fields = _fields()
+    ref = it_x(*fields)
+    out = it_p(*fields)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), ref, out):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-30)
+        assert rel < 1e-5, (name, rel)
+
+
+def test_matches_xla_chained(selfwrap_grid):
+    """Five chained iterations: halo invariants carried by the kernel feed
+    the next iteration's windows."""
+    params = stokes3d.Params()
+    kw = stokes3d._pseudo_steps(params)
+    fields = _fields()
+    r = o = fields[:4]
+    Rho = fields[4]
+    for _ in range(5):
+        r = stokes3d.local_iteration(*r, Rho, **kw)
+        o = stokes3d.local_iteration(*o, Rho, **kw, use_pallas=True,
+                                     pallas_interpret=True)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), r, o):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-30)
+        assert rel < 1e-5, (name, rel)
