@@ -37,11 +37,13 @@ def main():
 
     platform = jax.devices()[0].platform
     n = 256 if platform != "cpu" else 64
-    # Big dispatches (100 steps per compiled program) so the timing slope is
-    # dominated by compute, not by the ~100ms tunnel-readback jitter; median
-    # of 3 runs per path (min of a noisy estimator biases low — observed
-    # "rates" above the chip's HBM peak with small batches).
-    nt, n_inner, reps = (12, 100, 3) if platform != "cpu" else (2, 5, 1)
+    # Big dispatches (100 steps per compiled program) AND a slope window of
+    # >= 15 dispatches so the timing slope is dominated by compute, not the
+    # ~100ms tunnel-readback jitter; median of 3 runs per path.  (Round 2
+    # used a 6-dispatch window; its recorded 0.177 ms/step for the mega
+    # kernel was jitter — the audited number from three agreeing methods,
+    # including the pure device-side slope in K, is 0.237 ms/step.)
+    nt, n_inner, reps = (20, 100, 3) if platform != "cpu" else (2, 5, 1)
 
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
@@ -68,9 +70,24 @@ def main():
     best = min(xla_sec, pallas_sec) if pallas_sec is not None else xla_sec
     ms = best * 1e3
 
-    # Effective throughput (ideal-fusion bytes per step: read T, Cp; write T).
     cells = float(n) ** 3
-    gbps = 3 * cells * 4 / best / 1e9
+    # Equivalent ideal-fusion throughput (bytes a kernel touching only
+    # `read T + Cp, write T` would need): a speedup proxy, NOT a physical
+    # bandwidth — the mega-kernel exceeds "peak" here because it keeps Cp
+    # resident in VMEM.  The physical number is pct_hbm_peak, computed
+    # against the flagship path's actual per-step traffic
+    # T*(1+2/bx) + T_out (+ Cp/K, negligible), bx=8.
+    gbps_ideal = 3 * cells * 4 / best / 1e9
+    actual_bytes = cells * 4 * (1 + 2 / 8) + cells * 4
+    # Peak table by device kind; pct is only emitted when the peak is known
+    # (a wrong denominator would be worse than no number).
+    peaks = {"TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v5": 1228.0,
+             "TPU v4": 1228.0, "TPU v6e": 1640.0}
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = next((v for k, v in peaks.items() if kind.startswith(k)), None)
+    pct_peak = ((actual_bytes / best / 1e9) / peak * 100
+                if peak is not None and pallas_sec is not None
+                and best == pallas_sec else None)
 
     baseline_ms = 17.4  # ms/step/GPU, reference 510^3 on 8x P100
     result = {
@@ -81,13 +98,16 @@ def main():
         "xla_ms": round(xla_sec * 1e3, 4),
         "pallas_ms": (round(pallas_sec * 1e3, 4)
                       if pallas_sec is not None else None),
-        "gbps_ideal_traffic": round(gbps, 1),
+        "gbps_equivalent_ideal_fusion": round(gbps_ideal, 1),
+        "pct_hbm_peak_actual_traffic": (round(pct_peak, 1)
+                                        if pct_peak is not None else None),
+        "assumed_hbm_peak_gbps": peak if pct_peak is not None else None,
     }
     print(f"[bench] platform={platform} devices={grid.nprocs} "
           f"dims={grid.dims} local={n}^3 "
           f"xla={xla_sec * 1e3:.3f}ms pallas="
           f"{pallas_sec * 1e3 if pallas_sec is not None else float('nan'):.3f}ms "
-          f"~{gbps:.1f} GB/s effective", file=sys.stderr)
+          f"~{gbps_ideal:.1f} GB/s ideal-fusion equiv", file=sys.stderr)
     igg.finalize_global_grid()
     print(json.dumps(result))
 

@@ -7,7 +7,7 @@ Times each model's step on the same grid:
   - hidden   — `igg.hide_communication`: send planes from thin slab
                recomputations, so the full-domain stencil is
                data-independent of every collective;
-  - pallas   — diffusion only: the fused kernel, where applicable.
+  - pallas   — the fused kernel (diffusion and Stokes), where applicable.
 
 Models: `diffusion3d` (flagship, radius 1) and `stokes3d` (BASELINE config
 5's Stokes solver, radius 2 — run on an overlap-3 grid).  On a 1-device

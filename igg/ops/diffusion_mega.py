@@ -24,10 +24,18 @@ step from 3-plane x-end slabs of the current source buffer
 (`/root/reference/src/update_halo.jl:516-532` — every exchange is the
 self-neighbor path).
 
-Measured on TPU v5e at 256^3 f32 (K=100, bx=8): 0.237 ms/step — ~850 GB/s
-against the ideal-fusion traffic model (read T + Cp, write T), ~87% of the
-chip's HBM bandwidth against the actual per-step traffic
-`T*(1+2/bx) + T_out + A/K`; matches the per-step kernel path to 1 ulp.
+Measured on TPU v5e at 256^3 f32 (K=100, bx=8): **0.237 ms/step**, audited
+round 3 by three agreeing methods — dispatch-slope at K=100 (0.241), at
+K=200 (0.239), and the pure device-side slope in K ((t_K200 - t_K100)/100 =
+0.2366, immune to dispatch/readback artifacts).  Against the ACTUAL
+per-step HBM traffic `T*(1+2/bx) + T_out + A/K` ≈ 151 MB that is 638 GB/s,
+**78% of the chip's 819 GB/s HBM peak**.  The "~850 GB/s" figure sometimes
+quoted is the *equivalent ideal-fusion throughput* (what a kernel touching
+only `read T + Cp, write T` would need) — a speedup proxy, NOT a physical
+bandwidth, and it exceeds peak precisely because the mega-kernel eliminates
+the Cp read.  A round-2 record of 0.177 ms/step was a timing artifact of
+small slope batches under the tunnel's readback jitter and is superseded.
+Matches the per-step kernel path to 1 ulp.
 
 Not available in interpret mode (manual TPU DMA/semaphores); callers fall
 back to the per-step kernel.

@@ -23,7 +23,7 @@ def selfwrap_grid():
     igg.finalize_global_grid()
 
 
-def _fields(shapes_seed=0):
+def _fields():
     import jax.numpy as jnp
 
     params = stokes3d.Params()
@@ -71,8 +71,7 @@ def test_use_pallas_on_unsupported_grid_raises():
     params = stokes3d.Params()
     kw = stokes3d._pseudo_steps(params)
     fields = _fields()
-    import pytest as _pytest
-    with _pytest.raises(igg.GridError, match="fused Stokes"):
+    with pytest.raises(igg.GridError, match="fused Stokes"):
         stokes3d.local_iteration(*fields, **kw, use_pallas=True,
                                  pallas_interpret=True)
     igg.finalize_global_grid()
