@@ -2,21 +2,32 @@
 metric ("GB/s effective halo-exchange bandwidth per chip").
 
 Measures `update_halo` (the whole engine: squeezed-plane pack -> grouped
-ppermute/self-wrap -> aligned-DUS or masked-select unpack, dimension-
-sequential) for 1..N fields at once, amortized inside one XLA program per
-measurement, on two halo sets:
+ppermute/self-wrap -> in-place Pallas writer unpack, dimension-sequential)
+for 1..N fields at once, amortized inside one XLA program per measurement,
+on two halo sets:
 
-  - `xyz`: fully periodic 3-D — every dimension exchanges.  The lane (z)
-    dimension's halo tiles span 128/S of every tile row, so at S=256 this
-    update has a ~one-array-pass floor regardless of strategy (the engine's
-    single fused masked-select pass IS that floor; measured 160 us =
-    read+write of the block at HBM speed).  This is the TPU analog of the
+  - `xyz`: fully periodic 3-D — every dimension exchanges.  Updating the
+    lane (z) dimension's two outer planes is tile-granular (the DMA engine
+    only moves tile-aligned HBM windows), so at a 256-lane local size the
+    update IS one read-modify-write pass of the block; the one-pass writer
+    pins that floor deterministically: 203/102 us f32/bf16 at 256^3
+    (~630 GB/s of RMW traffic, the chip's sustained streaming rate), cost
+    strictly linear in the field count.  This is the TPU analog of the
     reference's worst-strided dim-1 plane
-    (`/root/reference/src/update_halo.jl:439-462`).
+    (`/root/reference/src/update_halo.jl:439-462`); see
+    `igg/ops/halo_write.py` for the full roofline argument.
   - `xy`: x/y periodic, z open — the halo set of the *recommended*
-    `(N,M,1)` pod decompositions (z unsplit).  The engine's aligned-DUS
-    strategy updates only the boundary slabs in place (donated buffers);
-    measured ~19 us at 256^3 f32, ~8x round 2's engine.
+    `(N,M,1)` pod decompositions (z unsplit).  The per-dim slab writers
+    touch only the dirty boundary tiles: ~22 us at 256^3 f32, again linear
+    in the field count.
+
+The headline "GB/s effective" divides the logical halo bytes (12 planes =
+`12*S^2*b`) by the wall time; for `xyz` the tile-granularity floor (an RMW
+pass moving `2*S^3*b`) makes it `6/S` of the RMW rate by construction
+(~15 GB/s at S=256 — NOT a statement about the engine's efficiency, which
+is at the floor; bf16 moves half the bytes in half the time, so its
+effective GB/s equals f32's).  `xy` reflects real slab traffic (~86 GB/s
+at 256^3).
 
 Accounting (stated so numbers are comparable across runs): per field and per
 participating dimension, every chip sends 2 boundary planes and receives 2 —

@@ -15,39 +15,43 @@ mutate-in-place semantics with zero extra copies).
 
 **Plane representation (round 3).**  Internally, planes are rank-preserving
 lazy slices (size 1 along the exchanged dimension) patched in masked-select
-form, so the whole update stays in rank- and layout-homogeneous XLA fusions
-(handing XLA rank-2 planes makes its layout assignment transpose the
-surrounding fusions and pay whole-array relayout copies; a materialized
-keepdims `(S0,S1,1)` plane is lane-padded up to ~40x).  Planes are squeezed
-to dense 2-D arrays (the reference's `halosize(dim,A)` shape,
+form, so plane algebra (corner propagation, open-boundary fallbacks) stays
+in rank- and layout-homogeneous XLA fusions (a materialized keepdims
+`(S0,S1,1)` plane is lane-padded up to ~40x).  Planes are squeezed to dense
+2-D arrays (the reference's `halosize(dim,A)` shape,
 `/root/reference/src/update_halo.jl:80`) only at the collective wire, where
 they must materialize anyway — so ppermute traffic and multi-field stacking
 move logical bytes, and nothing lane-padded ever reaches HBM or the ICI
-links.  Measured at 256^3 f32 on v5e, this plus the strategies below takes
-a 2-D-periodic update from 162 us to ~9-20 us.
+links.
 
-**Unpack strategies** (chosen per call signature by a static traffic model):
-  - *aligned-DUS*: per-dimension in-place updates — full planes along
+**Assembly strategies** (chosen per call signature by a static plan):
+  - *in-place Pallas writers* (`igg.ops.halo_write`, TPU compiled mode —
+    the default): partial-grid `pallas_call`s with the block aliased
+    in-place that touch ONLY the dirty tiles.  When the lane dimension
+    participates, that is one full RMW pass (the tile-granularity floor —
+    see `igg/ops/halo_write.py` for the roofline argument); otherwise the
+    dim-0/dim-1 slab writers touch a few MB.  Deterministic — XLA's layout
+    assignment for the equivalent HLO is a compile lottery (171-516 us for
+    the identical xyz update across surrounding-code variations, and
+    grouped multi-field calls went superlinear); the writers pin it at
+    203/102 us (f32/bf16 xyz at 256^3), ~22 us xy, cost strictly linear in
+    the field count.  Self-wrap (single-device periodic) y/z sources are
+    read from the block inside VMEM, so their planes never materialize.
+  - *aligned-DUS* (XLA fallback — CPU meshes, rank != 3, unaligned or
+    small shapes): per-dimension in-place updates — full planes along
     untiled (major) dimensions, tile-aligned slab read-modify-writes along
-    the sublane/lane dimensions.  XLA performs these in place on donated
-    buffers; cost is a few MB instead of a full-array pass.  Used when every
-    participating dimension is tile-aligned and the summed slab traffic is
-    below the one-pass cost — in particular for the recommended `(N,M,1)`
-    decompositions, whose halo sets avoid the minor (lane) dimension.
-  - *masked-select*: ONE fused pass writing the whole block with received
-    planes selected in (`jnp.where` on `broadcasted_iota`), in dimension
-    order.  The lane dimension's halo tiles span `128/S` of every tile row,
-    so for small-to-medium local grids any z-active exchange costs ~a full
-    pass no matter how it is written; the single fused pass IS the floor
-    (measured 159 us at 256^3 f32 — one HBM read + write).
+    the sublane/lane dimensions, performed in place on donated buffers.
+  - *masked-select* (last resort, same fallbacks): ONE fused pass writing
+    the whole block with received planes selected in (`jnp.where` on
+    `broadcasted_iota`), in dimension order.
 
 The reference meets the same wall on GPUs — its maximally-strided dim-1
 plane gets a dedicated custom kernel (`/root/reference/src/update_halo.jl:
 439-462`); on TPU the tiled layout moves that worst case to the lane (minor)
-dimension, and the pack side of it is handled by a Pallas one-pass plane
-extractor (`igg.ops.pack`, used for multi-plane minor-dim sends where XLA
-materializes each plane in a separate relayout pass — measured 491 us vs
-92 us for the 4-plane y+z pack at 256^3).
+dimension (the writer above), and the pack side of it is handled by a
+Pallas one-pass plane extractor (`igg.ops.pack`, used for multi-plane
+minor-dim sends where XLA materializes each plane in a separate relayout
+pass — measured 491 us vs 92 us for the 4-plane y+z pack at 256^3).
 
 Preserved reference semantics:
   - exactly one boundary plane is exchanged per side per dimension:
@@ -495,26 +499,60 @@ def _is_tpu(grid) -> bool:
         return False
 
 
+def _writer_dims(A, dims, grid):
+    """Partition a field's moving dims for the one-pass Pallas writer path:
+    returns `(wraps, use_writer)` where `wraps` are the single-device
+    periodic dims whose halos the writer assembles from in-VMEM self-wrap
+    sources (never materializing a lane-padded plane), and `use_writer` says
+    the field's assembly goes through :func:`igg.ops.halo_write.halo_write`
+    (TPU, rank-3, supported dtype, lane dim participating — elsewhere the
+    XLA aligned-DUS/select plans are faster or required)."""
+    from .ops.halo_write import halo_write_supported, slab_write_supported
+
+    wraps = frozenset(d for d, _ in dims
+                      if grid.dims[d] == 1 and grid.periods[d])
+    dd = [d for d, _ in dims]
+    lane_active = any(d == A.ndim - 1 for d, _ in dims)
+    if lane_active:
+        use_writer = (halo_write_supported(A.shape, A.dtype)
+                      and _assembly_plan(A.shape, A.dtype, dd) != "select")
+    else:
+        use_writer = slab_write_supported(A.shape, A.dtype, dd)
+    return wraps, use_writer
+
+
 def _update_halo_impl(fields: List, grid) -> Tuple:
     """Halo update of all fields' local blocks: pack squeezed send planes
     (inner plane `ol-1` / `s-ol`, `/root/reference/src/update_halo.jl:
     386-394`), exchange dimension-sequentially with grouped collectives and
-    corner propagation, assemble per the static plan.
+    corner propagation, then assemble — with the one-pass in-place Pallas
+    writer when the lane dimension participates (see
+    :mod:`igg.ops.halo_write` for why), the XLA plans otherwise.
 
     (When every active dimension is periodic with a single device and
     overlap 2, the update is algebraically `pad(interior, mode='wrap')`;
     measured on TPU v5e that form does NOT fuse — it regressed both here
     and as a model-level fast path, so the plane machinery below is used
     everywhere.)"""
-    from .ops.pack import pack_planes_supported, pack_planes
+    import jax.numpy as jnp
 
-    use_pack = _is_tpu(grid)
-    shapes, sends, dims_moving = [], [], []
+    from .ops.pack import pack_planes_supported, pack_planes
+    from .ops.halo_write import halo_write, halo_write_slabs
+
+    on_tpu = _is_tpu(grid)
+    shapes, sends, dims_moving, wraps, writer = [], [], [], [], []
     for A in fields:
         s = A.shape
         dims = moving_dims(active_dims(s, grid), grid)
+        w, use_writer = (_writer_dims(A, dims, grid) if on_tpu
+                         else (frozenset(), False))
+        # Send planes are needed for exchanged dims always, and for wrap
+        # dims only on the XLA path (the writer reads wrap sources from the
+        # block in VMEM; dim-0 wraps are cheap lazy slices either way).
         plane_req = {}
         for d, ol in dims:
+            if use_writer and d in w and d > 0:
+                continue
             plane_req[(d, 0)] = (d, ol - 1)
             plane_req[(d, 1)] = (d, s[d] - ol)
         send = {}
@@ -524,8 +562,7 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
         # stays a lazy slice that fuses into its consumer.
         minor = [k for k, (d, _) in plane_req.items()
                  if grid.dims[d] > 1 and d >= A.ndim - 2 and A.ndim == 3]
-        if use_pack and len(minor) >= 2 and pack_planes_supported(s):
-            import jax.numpy as jnp
+        if on_tpu and len(minor) >= 2 and pack_planes_supported(s):
             packed = pack_planes(A, [plane_req[k] for k in minor])
             send.update({k: jnp.expand_dims(p, plane_req[k][0])
                          for k, p in zip(minor, packed)})
@@ -535,11 +572,36 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
         shapes.append(s)
         sends.append(send)
         dims_moving.append(dims)
+        wraps.append(w if use_writer else frozenset())
+        writer.append(use_writer)
 
     recvs = exchange_all_dims_grouped(shapes, sends, dims_moving, grid,
-                                      blocks=fields)
-    return tuple(assemble_planes(A, recvs[i], dims_moving[i])
-                 for i, A in enumerate(fields))
+                                      wraps=wraps, blocks=fields)
+
+    out = []
+    for i, A in enumerate(fields):
+        dims = dims_moving[i]
+        if not writer[i]:
+            out.append(assemble_planes(A, recvs[i], dims))
+            continue
+        s = A.shape
+        lane_active = any(d == A.ndim - 1 for d, _ in dims)
+        specs = []
+        for d, ol in dims:
+            if d in wraps[i]:
+                if d == 0:
+                    specs.append((0, "ext",
+                                  jnp.squeeze(_plane(A, 0, s[0] - ol), 0),
+                                  jnp.squeeze(_plane(A, 0, ol - 1), 0)))
+                else:
+                    specs.append((d, "wrap", ol))
+            else:
+                first, last = recvs[i][d]
+                specs.append((d, "ext", jnp.squeeze(first, d),
+                              jnp.squeeze(last, d)))
+        out.append(halo_write(A, specs) if lane_active
+                   else halo_write_slabs(A, specs))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,339 @@
+"""One-pass in-place Pallas halo writer — deterministic assembly for
+lane-dimension halos.
+
+Why this kernel exists: on TPU, writing the two outer planes of the minor
+(lane) dimension is tile-granular — the Mosaic DMA engine only moves
+tile-aligned HBM windows (sublane slices in multiples of the sublane tile,
+lane slices in multiples of 128; a single-plane HBM DMA fails to compile
+with "Slice shape along dimension must be aligned to tiling").  Any update
+that materializes a lane-dim halo therefore costs a read-modify-write of
+every tile column containing the halo lanes; at a 256-lane local size that
+is ALL columns, i.e. one full read+write pass of the block (~128 MB at
+256^3 f32 — measured 203 us = 630 GB/s, the same rate a pure in-place
+Pallas copy and the audited mega-kernel sustain on v5e).  This is the TPU
+analog of the reference's maximally-strided dim-1 plane, which gets its own
+custom kernel for the same reason (`/root/reference/src/update_halo.jl:
+439-462`).
+
+XLA can express the same one-pass update (masked-select chain or aligned
+DUS), but its layout assignment is a compile lottery: the identical update
+program measured anywhere from 171 us to 516 us across surrounding-code
+variations at 256^3 f32 — sometimes inserting whole-array relayout copies
+({2,0,1}/{1,0,2} layouts) around minor-dim plane extraction, and grouped
+multi-field calls went superlinear (4 fields = 2.2x the cost of 4 x
+1 field).  This kernel pins the strategy: ONE aliased in-place RMW pass,
+patching every participating dimension in dimension order (later dims win
+the shared corner cells — the reference's sequential-overwrite semantics,
+`/root/reference/src/update_halo.jl:36,130`), with per-field cost exactly
+one block pass (multi-field grouped calls scale linearly), and bf16 at half
+the f32 cost (101 us) instead of 1.5x.
+
+Per-dimension source modes:
+  - ``("ext", first, last)`` — dense squeezed 2-D received planes (what
+    `ppermute` delivers), or any XLA expression (e.g. lazy keepdims slices
+    for the dim-0 self-wrap sources, squeezed — free for the major dim).
+  - ``("wrap", ol)`` — single-device periodic self-wrap: halo rows are
+    copied from the block's own inner send planes (`ol-1` / `s-ol`) INSIDE
+    VMEM, so the lane/sublane planes never materialize in HBM at all (the
+    pack-side relayout tax is zero).  Only valid for dims >= 1 (dim 0 wrap
+    sources cross grid blocks; callers pass them as lazy "ext" slices).
+
+Used by the halo engine whenever the lane dimension participates in the
+update on TPU; the engine keeps XLA's aligned-DUS for sublane/major-only
+halo sets (boundary-slab in-place writes, ~20 us at 256^3 — a full pass
+would be a 10x regression there).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+_VMEM_LIMIT = 100 * 1024 * 1024
+# Element sizes the writers handle: 32-bit natively; bf16/f16 round-trip
+# through f32 for the lane-dim plane expand (Mosaic: "Insertion of minor dim
+# that is not a no-op only supported for 32-bit types"), which is exact.
+# 64-bit would hit the same Mosaic limitation with no exact round-trip, so
+# those fields take the XLA fallback plans.
+_EXPAND_OK = (2, 4)
+
+
+def _pick_bx(n0: int, n1: int, n2: int, itemsize: int) -> int:
+    """Largest power-of-two block row count <= 32 that divides n0 and keeps
+    the double-buffered in+out blocks comfortably inside VMEM."""
+    bx = 1
+    while (n0 % (bx * 2) == 0 and bx * 2 <= 32
+           and 4 * (bx * 2) * n1 * n2 * itemsize <= _VMEM_LIMIT // 2):
+        bx *= 2
+    return bx
+
+
+def halo_write_supported(shape, dtype) -> bool:
+    """The writer handles rank-3 blocks of >= 16-bit elements (16-bit lane
+    expansion round-trips exactly through f32)."""
+    import numpy as np
+
+    if len(shape) != 3:
+        return False
+    if np.dtype(dtype).itemsize not in _EXPAND_OK:
+        return False
+    n0, n1, n2 = shape
+    return n0 >= 2 and n1 >= 2 and n2 >= 2
+
+
+def _expand_minor(p, dtype):
+    """`p[..., None]` that Mosaic accepts for 16-bit types."""
+    import jax.numpy as jnp
+
+    if jnp.dtype(dtype).itemsize >= 4:
+        return p[..., None]
+    return p.astype(jnp.float32)[..., None].astype(dtype)
+
+
+def slab_write_supported(shape, dtype, dims) -> bool:
+    """Whether the per-dim slab writers cover a halo set (no lane dim):
+    rank-3, dim-1 updates need tile-aligned rows with distinct first/last
+    tiles."""
+    import numpy as np
+
+    if len(shape) != 3 or (len(shape) - 1) in dims:
+        return False
+    if np.dtype(dtype).itemsize not in _EXPAND_OK:
+        return False
+    ts = _sublane_tile(np.dtype(dtype).itemsize)
+    if 1 in dims and (shape[1] % ts != 0 or shape[1] < 2 * ts):
+        return False
+    return shape[0] >= 2
+
+
+def _sublane_tile(itemsize: int) -> int:
+    from ..halo import _SUBLANE  # single source of truth for tile heights
+
+    return _SUBLANE.get(itemsize, 8)
+
+
+def _write_dim0(A, first, last, *, interpret: bool):
+    """In-place overwrite of the two outer dim-0 planes (untiled dim: the
+    blocks ARE the planes; ~2 plane writes, no RMW)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n0, n1, n2 = A.shape
+
+    def kernel(pf_ref, pq_ref, a_ref, o_ref):
+        j = pl.program_id(0)
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[...] = pf_ref[...][None, :, :]
+
+        @pl.when(j == 1)
+        def _():
+            o_ref[...] = pq_ref[...][None, :, :]
+
+    vma = getattr(getattr(A, "aval", None), "vma", None)
+    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((n1, n2), lambda j: (0, 0)),
+                  pl.BlockSpec((n1, n2), lambda j: (0, 0)),
+                  pl.BlockSpec((1, n1, n2), lambda j: (j * (n0 - 1), 0, 0))],
+        out_specs=pl.BlockSpec((1, n1, n2), lambda j: (j * (n0 - 1), 0, 0)),
+        out_shape=out_shape,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(first, last, A)
+
+
+def _write_dim1(A, spec, *, interpret: bool):
+    """In-place RMW of the two outer dim-1 (sublane) planes: only the two
+    boundary sublane-tile slabs are touched (~`2*ts/n1` of the block).
+    `spec` is `("ext", first, last)` with dense `(n0, n2)` planes or
+    `("wrap", ol)` (source rows fetched from their slabs by extra refs)."""
+    import jax
+    import numpy as np
+    from jax import lax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n0, n1, n2 = A.shape
+    ts = _sublane_tile(np.dtype(A.dtype).itemsize)
+    bx = _pick_bx(n0, n1, n2, np.dtype(A.dtype).itemsize)
+    nb = n0 // bx
+    njb = n1 // ts
+    wrap = spec[0] == "wrap"
+    ol = spec[1] if wrap else None
+
+    def kernel(s0_ref, s1_ref, a_ref, o_ref):
+        j = pl.program_id(1)
+        t = a_ref[...]
+        idx = lax.broadcasted_iota(jnp.int32, t.shape, 1)
+        if wrap:
+            pf = s0_ref[:, (n1 - ol) % ts, :]
+            pq = s1_ref[:, (ol - 1) % ts, :]
+        else:
+            pf = s0_ref[...]
+            pq = s1_ref[...]
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[...] = jnp.where(idx == 0, pf[:, None, :], t)
+
+        @pl.when(j == 1)
+        def _():
+            o_ref[...] = jnp.where(idx == ts - 1, pq[:, None, :], t)
+
+    if wrap:
+        # The wrap source rows are pre-sliced (tile-aligned slabs) at the
+        # XLA level into fresh small buffers: passing `A` itself as an extra
+        # operand of its own aliased in-place update makes XLA insert a
+        # defensive whole-array copy (measured 427 us instead of ~25 us for
+        # the xy self-wrap update at 256^3 f32).
+        base0 = ((n1 - ol) // ts) * ts
+        base1 = ((ol - 1) // ts) * ts
+        s0 = lax.slice_in_dim(A, base0, base0 + ts, axis=1)
+        s1 = lax.slice_in_dim(A, base1, base1 + ts, axis=1)
+        in_specs = [pl.BlockSpec((bx, ts, n2), lambda i, j: (i, 0, 0)),
+                    pl.BlockSpec((bx, ts, n2), lambda i, j: (i, 0, 0))]
+        args = (s0, s1)
+        alias = 2
+    else:
+        in_specs = [pl.BlockSpec((bx, n2), lambda i, j: (i, 0)),
+                    pl.BlockSpec((bx, n2), lambda i, j: (i, 0))]
+        args = (spec[1], spec[2])
+        alias = 2
+    in_specs.append(
+        pl.BlockSpec((bx, ts, n2), lambda i, j: (i, j * (njb - 1), 0)))
+
+    vma = getattr(getattr(A, "aval", None), "vma", None)
+    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=(nb, 2),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bx, ts, n2),
+                               lambda i, j: (i, j * (njb - 1), 0)),
+        out_shape=out_shape,
+        input_output_aliases={alias: 0},
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*args, A)
+
+
+def halo_write_slabs(A, specs: Sequence[Tuple], *, interpret: bool = False):
+    """Non-lane halo assembly: chain per-dim in-place slab writers in
+    dimension order (later dims win corners).  Touches only the dirty
+    boundary slabs (~20-30 us at 256^3 vs a 200 us full pass), with cost
+    strictly linear in the number of fields.  Dim-0 wrap sources must be
+    passed as lazy "ext" slices (they cross grid blocks)."""
+    for s in specs:
+        d = s[0]
+        if d == 0:
+            if s[1] != "ext":
+                raise ValueError("dim-0 wrap sources cross grid blocks; "
+                                 "pass them as lazy 'ext' slices")
+            A = _write_dim0(A, s[2], s[3], interpret=interpret)
+        elif d == 1:
+            A = _write_dim1(A, s[1:], interpret=interpret)
+        else:
+            raise ValueError("lane dim: use halo_write")
+    return A
+
+
+def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
+    """Return `A` with its outer halo planes overwritten, in one in-place
+    RMW pass (input buffer aliased to the output).
+
+    `specs` is a list of `(dim, mode, ...)` entries in increasing dim order:
+    `(d, "ext", first, last)` with dense 2-D planes (the squeezed plane
+    shape of dim `d`), or `(d, "wrap", ol)` for `d >= 1`.
+    """
+    import jax
+    import numpy as np
+    from jax import lax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n0, n1, n2 = A.shape
+    bx = _pick_bx(n0, n1, n2, np.dtype(A.dtype).itemsize)
+    nb = n0 // bx
+
+    ext_planes: List = []
+    for s in specs:
+        if s[1] == "ext":
+            ext_planes += [s[2], s[3]]
+        elif s[0] == 0:
+            raise ValueError("dim-0 wrap sources cross grid blocks; pass "
+                             "them as lazy 'ext' slices")
+
+    def kernel(*refs):
+        *plane_refs, a_ref, o_ref = refs
+        i = pl.program_id(0)
+        t = a_ref[...]
+        k = 0
+        for s in specs:
+            d = s[0]
+            if s[1] == "ext":
+                pf, pq = plane_refs[k][...], plane_refs[k + 1][...]
+                k += 2
+                if d == 0:
+                    idx = lax.broadcasted_iota(jnp.int32, t.shape, 0) + i * bx
+                    t = jnp.where(idx == 0, pf[None, :, :], t)
+                    t = jnp.where(idx == n0 - 1, pq[None, :, :], t)
+                elif d == 1:
+                    idx = lax.broadcasted_iota(jnp.int32, t.shape, 1)
+                    t = jnp.where(idx == 0, pf[:, None, :], t)
+                    t = jnp.where(idx == n1 - 1, pq[:, None, :], t)
+                else:
+                    idx = lax.broadcasted_iota(jnp.int32, t.shape, 2)
+                    t = jnp.where(idx == 0, _expand_minor(pf, t.dtype), t)
+                    t = jnp.where(idx == n2 - 1, _expand_minor(pq, t.dtype),
+                                  t)
+            else:
+                ol = s[2]
+                if d == 1:
+                    idx = lax.broadcasted_iota(jnp.int32, t.shape, 1)
+                    t = jnp.where(idx == 0, t[:, n1 - ol:n1 - ol + 1, :], t)
+                    t = jnp.where(idx == n1 - 1, t[:, ol - 1:ol, :], t)
+                else:
+                    idx = lax.broadcasted_iota(jnp.int32, t.shape, 2)
+                    t = jnp.where(idx == 0, t[:, :, n2 - ol:n2 - ol + 1], t)
+                    t = jnp.where(idx == n2 - 1, t[:, :, ol - 1:ol], t)
+        o_ref[...] = t
+
+    in_specs = []
+    for s in specs:
+        if s[1] != "ext":
+            continue
+        d = s[0]
+        if d == 0:
+            bs = pl.BlockSpec((n1, n2), lambda i: (0, 0))
+        elif d == 1:
+            bs = pl.BlockSpec((bx, n2), lambda i: (i, 0))
+        else:
+            bs = pl.BlockSpec((bx, n1), lambda i: (i, 0))
+        in_specs += [bs, bs]
+    in_specs.append(pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)))
+
+    vma = getattr(getattr(A, "aval", None), "vma", None)
+    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)),
+        out_shape=out_shape,
+        input_output_aliases={len(ext_planes): 0},
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*ext_planes, A)

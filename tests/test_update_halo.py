@@ -251,3 +251,118 @@ class TestLocalForm:
         z = zero_halo_blocks(b, (6, 6, 6))
         out = np.array(step(jax.device_put(z, igg.sharding_for(3))))
         np.testing.assert_array_equal(out, expected_after_update(b, z, (6, 6, 6)))
+
+
+# ---------------------------------------------------------------------------
+# One-pass in-place Pallas writer (igg/ops/halo_write.py), interpret mode.
+# On TPU this kernel performs the assembly whenever the lane dim participates;
+# here its semantics are pinned against a numpy oracle for every source-mode
+# combination the engine generates.
+# ---------------------------------------------------------------------------
+
+class TestHaloWriter:
+    @staticmethod
+    def _oracle(A, specs):
+        ref = np.array(A, dtype=np.float64).copy()
+        nd = ref.ndim
+        for s in specs:
+            d = s[0]
+            sl0, sl1 = [slice(None)] * nd, [slice(None)] * nd
+            sl0[d], sl1[d] = 0, ref.shape[d] - 1
+            if s[1] == "ext":
+                ref[tuple(sl0)] = np.asarray(s[2], dtype=np.float64)
+                ref[tuple(sl1)] = np.asarray(s[3], dtype=np.float64)
+            else:
+                ol = s[2]
+                src0, src1 = [slice(None)] * nd, [slice(None)] * nd
+                src0[d], src1[d] = ref.shape[d] - ol, ol - 1
+                ref[tuple(sl0)] = ref[tuple(src0)]
+                ref[tuple(sl1)] = ref[tuple(src1)]
+        return ref
+
+    @pytest.mark.parametrize("modes", [
+        ("ext", "ext", "ext"),
+        ("ext", "wrap", "wrap"),
+        ("ext", "ext", "wrap"),
+        ("ext", "wrap", "ext"),
+        (None, "wrap", "wrap"),
+        (None, None, "ext"),
+        (None, None, "wrap"),
+        ("ext", None, "wrap"),
+    ])
+    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+    def test_against_oracle(self, modes, dtype):
+        import jax.numpy as jnp
+        from igg.ops.halo_write import halo_write
+
+        if dtype == "bfloat16":
+            dtype = jnp.bfloat16
+        rng = np.random.default_rng(42)
+        shape = (8, 10, 12)
+        A = jnp.asarray(rng.integers(0, 63, shape), dtype=dtype)
+        specs = []
+        plane_shapes = {0: (10, 12), 1: (8, 12), 2: (8, 10)}
+        for d, mode in enumerate(modes):
+            if mode is None:
+                continue
+            if mode == "ext":
+                specs.append((d, "ext",
+                              jnp.asarray(rng.integers(0, 63,
+                                                       plane_shapes[d]),
+                                          dtype=dtype),
+                              jnp.asarray(rng.integers(0, 63,
+                                                       plane_shapes[d]),
+                                          dtype=dtype)))
+            else:
+                specs.append((d, "wrap", 2 + d % 2))
+        out = halo_write(A, specs, interpret=True)
+        exp = self._oracle(A, specs)
+        np.testing.assert_array_equal(
+            np.array(out, dtype=np.float64), exp)
+
+    def test_dim0_wrap_rejected(self):
+        import jax.numpy as jnp
+        from igg.ops.halo_write import halo_write
+
+        A = jnp.zeros((8, 8, 8))
+        with pytest.raises(ValueError, match="dim-0 wrap"):
+            halo_write(A, [(0, "wrap", 2)], interpret=True)
+
+
+class TestSlabWriters:
+    """Per-dim in-place slab writers (non-lane halo sets), interpret mode."""
+
+    @pytest.mark.parametrize("modes", [
+        ("ext", None), ("ext", "ext"), ("ext", "wrap"),
+        (None, "ext"), (None, "wrap"),
+    ])
+    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+    def test_against_oracle(self, modes, dtype):
+        import jax.numpy as jnp
+        from igg.ops.halo_write import _sublane_tile, halo_write_slabs
+
+        if dtype == "bfloat16":
+            dtype = jnp.bfloat16
+        ts = _sublane_tile(np.dtype(dtype).itemsize)
+        n1 = 4 * ts  # tile-aligned with distinct first/last tiles
+        rng = np.random.default_rng(3)
+        shape = (8, n1, 12)
+        A = jnp.asarray(rng.integers(0, 63, shape), dtype=dtype)
+        specs = []
+        plane_shapes = {0: (n1, 12), 1: (8, 12)}
+        for d, mode in enumerate(modes):
+            if mode is None:
+                continue
+            if mode == "ext":
+                specs.append((d, "ext",
+                              jnp.asarray(rng.integers(0, 63,
+                                                       plane_shapes[d]),
+                                          dtype=dtype),
+                              jnp.asarray(rng.integers(0, 63,
+                                                       plane_shapes[d]),
+                                          dtype=dtype)))
+            else:
+                specs.append((d, "wrap", 3))
+        out = halo_write_slabs(A, specs, interpret=True)
+        exp = TestHaloWriter._oracle(A, specs)
+        np.testing.assert_array_equal(np.array(out, dtype=np.float64), exp)
