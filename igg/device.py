@@ -32,13 +32,32 @@ import numpy as np
 from .shared import GridError, check_initialized
 
 
+def _machine_id() -> str:
+    """A machine-unique component beyond the hostname: containerized
+    deployments routinely give distinct hosts identical hostnames, which
+    would merge them into one 'node' and corrupt node-local ranks (or raise
+    a spurious over-subscription error).  `/etc/machine-id` is stable across
+    boots; `boot_id` distinguishes machines that lack it; hostname-only is
+    the last resort."""
+    for path in ("/etc/machine-id", "/proc/sys/kernel/random/boot_id"):
+        try:
+            with open(path) as f:
+                v = f.read().strip()
+            if v:
+                return v
+        except OSError:
+            continue
+    return ""
+
+
 def _host_fingerprint() -> np.ndarray:
     """A stable per-host identifier, as two uint32s (transportable on meshes
     without x64 enabled).  `--xla_force_host_platform_device_count` test
     processes on one machine deliberately share a fingerprint — they model
     multiple ranks on one node, the exact case the reference's
     `Comm_split_type(SHARED)` exists for."""
-    digest = hashlib.sha1(socket.gethostname().encode()).digest()
+    ident = f"{socket.gethostname()}|{_machine_id()}"
+    digest = hashlib.sha1(ident.encode()).digest()
     lo = int.from_bytes(digest[0:4], "big")
     hi = int.from_bytes(digest[4:8], "big")
     return np.array([lo, hi], dtype=np.uint32)

@@ -88,6 +88,11 @@ from .shared import AXIS_NAMES, NDIMS, GridError
 # loop never re-traces/re-allocates.
 _compiled: Dict[tuple, object] = {}
 
+# Test seam: force the Pallas-writer assembly path (interpret mode) on
+# non-TPU meshes, so the engine-side spec building (wrap/ext classification,
+# squeeze axes, recv wiring) is exercised by the CPU suite.
+_FORCE_WRITER_INTERPRET = False
+
 
 def free_update_halo_buffers() -> None:
     """Drop all compiled halo programs (reference
@@ -544,14 +549,16 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
     for A in fields:
         s = A.shape
         dims = moving_dims(active_dims(s, grid), grid)
-        w, use_writer = (_writer_dims(A, dims, grid) if on_tpu
+        w, use_writer = (_writer_dims(A, dims, grid)
+                         if on_tpu or _FORCE_WRITER_INTERPRET
                          else (frozenset(), False))
         # Send planes are needed for exchanged dims always, and for wrap
-        # dims only on the XLA path (the writer reads wrap sources from the
-        # block in VMEM; dim-0 wraps are cheap lazy slices either way).
+        # dims only on the XLA path: the exchange never reads a wrap dim's
+        # sends, and the writer sources wrap halos itself (y/z from the
+        # block in VMEM, dim 0 from its own lazy slices).
         plane_req = {}
         for d, ol in dims:
-            if use_writer and d in w and d > 0:
+            if use_writer and d in w:
                 continue
             plane_req[(d, 0)] = (d, ol - 1)
             plane_req[(d, 1)] = (d, s[d] - ol)
@@ -599,8 +606,9 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
                 first, last = recvs[i][d]
                 specs.append((d, "ext", jnp.squeeze(first, d),
                               jnp.squeeze(last, d)))
-        out.append(halo_write(A, specs) if lane_active
-                   else halo_write_slabs(A, specs))
+        interp = _FORCE_WRITER_INTERPRET
+        out.append(halo_write(A, specs, interpret=interp) if lane_active
+                   else halo_write_slabs(A, specs, interpret=interp))
     return tuple(out)
 
 

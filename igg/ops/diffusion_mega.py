@@ -50,12 +50,17 @@ from functools import partial
 _VMEM_BUDGET = 110 * 1024 * 1024
 
 
-def mega_supported(shape, bx: int, n_inner: int, interpret: bool) -> bool:
+def mega_supported(shape, bx: int, n_inner: int, interpret: bool,
+                   dtype) -> bool:
     """Whether the K-step mega-kernel applies to a local block of `shape`:
     compiled mode only, at least two steps (with one step, the donated
     input buffer doubles as the output and the last program's wrapping
     fetch would read a row already overwritten), and the coefficient array
-    plus working buffers must fit in VMEM."""
+    plus working buffers — sized at the ACTUAL element width — must fit in
+    VMEM (a hard-coded 4 would under-estimate wider dtypes and fail at
+    Mosaic compile time instead of falling back to the per-step kernel)."""
+    import numpy as np
+
     if interpret or n_inner < 2:
         return False
     S0, S1, S2 = shape
@@ -63,7 +68,8 @@ def mega_supported(shape, bx: int, n_inner: int, interpret: bool) -> bool:
         return False
     if S0 < 2 * bx:  # the wrapping edge fetches assume >= 2 slabs per step
         return False
-    need = 4 * (S0 * S1 * S2            # A resident
+    itemsize = np.dtype(dtype).itemsize
+    need = itemsize * (S0 * S1 * S2       # A resident
                 + 2 * (bx + 2) * S1 * S2  # ext slabs (double-buffered)
                 + 2 * bx * S1 * S2        # out slabs (double-buffered)
                 + 8 * S1 * S2)            # x-plane scratch

@@ -444,7 +444,7 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
 
         # Fastest: the whole inner loop as ONE pallas_call with the
         # coefficient array resident in VMEM (see `diffusion_mega`).
-        if mega_supported(T.shape, bx, n_inner, interpret):
+        if mega_supported(T.shape, bx, n_inner, interpret, dtype=T.dtype):
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal)
 

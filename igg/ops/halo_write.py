@@ -111,12 +111,37 @@ def _sublane_tile(itemsize: int) -> int:
     return _SUBLANE.get(itemsize, 8)
 
 
-def _write_dim0(A, first, last, *, interpret: bool):
-    """In-place overwrite of the two outer dim-0 planes (untiled dim: the
-    blocks ARE the planes; ~2 plane writes, no RMW)."""
+
+def _inplace_call(kernel, A, *, grid, in_specs, out_spec, alias, args,
+                  interpret):
+    """Shared `pallas_call` wrapper for the in-place writers: aliases `A`
+    (the last operand) to the output, preserves shard_map varying-manual
+    axes (vma) on the out aval, and applies the VMEM limit in compiled
+    mode."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    vma = getattr(getattr(A, "aval", None), "vma", None)
+    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=list(in_specs),
+        out_specs=out_spec,
+        out_shape=out_shape,
+        input_output_aliases={alias: 0},
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(*args, A)
+
+
+def _write_dim0(A, first, last, *, interpret: bool):
+    """In-place overwrite of the two outer dim-0 planes (untiled dim: the
+    blocks ARE the planes; ~2 plane writes, no RMW)."""
+    from jax.experimental import pallas as pl
 
     n0, n1, n2 = A.shape
 
@@ -131,22 +156,13 @@ def _write_dim0(A, first, last, *, interpret: bool):
         def _():
             o_ref[...] = pq_ref[...][None, :, :]
 
-    vma = getattr(getattr(A, "aval", None), "vma", None)
-    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
-                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
-    return pl.pallas_call(
-        kernel,
-        grid=(2,),
+    return _inplace_call(
+        kernel, A, grid=(2,),
         in_specs=[pl.BlockSpec((n1, n2), lambda j: (0, 0)),
                   pl.BlockSpec((n1, n2), lambda j: (0, 0)),
                   pl.BlockSpec((1, n1, n2), lambda j: (j * (n0 - 1), 0, 0))],
-        out_specs=pl.BlockSpec((1, n1, n2), lambda j: (j * (n0 - 1), 0, 0)),
-        out_shape=out_shape,
-        input_output_aliases={2: 0},
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
-    )(first, last, A)
+        out_spec=pl.BlockSpec((1, n1, n2), lambda j: (j * (n0 - 1), 0, 0)),
+        alias=2, args=(first, last), interpret=interpret)
 
 
 def _write_dim1(A, spec, *, interpret: bool):
@@ -154,12 +170,10 @@ def _write_dim1(A, spec, *, interpret: bool):
     boundary sublane-tile slabs are touched (~`2*ts/n1` of the block).
     `spec` is `("ext", first, last)` with dense `(n0, n2)` planes or
     `("wrap", ol)` (source rows fetched from their slabs by extra refs)."""
-    import jax
     import numpy as np
     from jax import lax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     n0, n1, n2 = A.shape
     ts = _sublane_tile(np.dtype(A.dtype).itemsize)
@@ -210,21 +224,11 @@ def _write_dim1(A, spec, *, interpret: bool):
     in_specs.append(
         pl.BlockSpec((bx, ts, n2), lambda i, j: (i, j * (njb - 1), 0)))
 
-    vma = getattr(getattr(A, "aval", None), "vma", None)
-    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
-                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
-    return pl.pallas_call(
-        kernel,
-        grid=(nb, 2),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((bx, ts, n2),
-                               lambda i, j: (i, j * (njb - 1), 0)),
-        out_shape=out_shape,
-        input_output_aliases={alias: 0},
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
-    )(*args, A)
+    return _inplace_call(
+        kernel, A, grid=(nb, 2), in_specs=in_specs,
+        out_spec=pl.BlockSpec((bx, ts, n2),
+                              lambda i, j: (i, j * (njb - 1), 0)),
+        alias=alias, args=args, interpret=interpret)
 
 
 def halo_write_slabs(A, specs: Sequence[Tuple], *, interpret: bool = False):
@@ -255,12 +259,10 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
     `(d, "ext", first, last)` with dense 2-D planes (the squeezed plane
     shape of dim `d`), or `(d, "wrap", ol)` for `d >= 1`.
     """
-    import jax
     import numpy as np
     from jax import lax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     n0, n1, n2 = A.shape
     bx = _pick_bx(n0, n1, n2, np.dtype(A.dtype).itemsize)
@@ -323,17 +325,7 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
         in_specs += [bs, bs]
     in_specs.append(pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)))
 
-    vma = getattr(getattr(A, "aval", None), "vma", None)
-    out_shape = (jax.ShapeDtypeStruct(A.shape, A.dtype, vma=vma) if vma
-                 else jax.ShapeDtypeStruct(A.shape, A.dtype))
-    return pl.pallas_call(
-        kernel,
-        grid=(nb,),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)),
-        out_shape=out_shape,
-        input_output_aliases={len(ext_planes): 0},
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
-    )(*ext_planes, A)
+    return _inplace_call(
+        kernel, A, grid=(nb,), in_specs=in_specs,
+        out_spec=pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)),
+        alias=len(ext_planes), args=tuple(ext_planes), interpret=interpret)

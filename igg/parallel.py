@@ -95,18 +95,20 @@ def _device_varying_outvars(jaxpr, in_varying, all_axes=None) -> list:
     different values on different devices?  Taint sources are the sharded
     inputs (`in_varying`) and device-varying primitives (`axis_index`,
     `ppermute`, ... — including inside scan/cond/pjit sub-jaxprs); any eqn
-    touching taint taints all its outputs.  One untaint rule: a `psum` over
-    every (non-trivial) mesh axis yields the same value on all devices, so
-    its results are clean — this makes "reduce your diagnostic with psum"
-    an actually-working remedy.  Untainted outputs are provably identical on
-    every device, so replicating them is correct by construction — never a
+    touching taint taints all its outputs.  One untaint rule: a
+    `psum`/`pmax`/`pmin` over every (non-trivial) mesh axis yields the same
+    value on all devices, so its results are clean — this makes "reduce
+    your diagnostic with a full-mesh collective" an actually-working remedy
+    (pmax/pmin matter for max/min-norm diagnostics, where psum would be
+    numerically wrong).  Untainted outputs are provably identical on every
+    device, so replicating them is correct by construction — never a
     shape-proximity guess."""
     from jax.extend import core
 
     all_axes = frozenset(all_axes or ())
     tainted = {v for v, t in zip(jaxpr.invars, in_varying) if t}
     for eqn in jaxpr.eqns:
-        if (eqn.primitive.name == "psum"
+        if (eqn.primitive.name in ("psum", "pmax", "pmin")
                 and eqn.params.get("axis_index_groups") is None
                 and all_axes <= set(eqn.params.get("axes", ()))):
             continue  # full-mesh reduction: device-invariant result
@@ -273,9 +275,9 @@ def sharded(fn=None, *, donate_argnums: Sequence[int] = (),
                                     f"differ per device but is not "
                                     f"grid-block shaped — ambiguous (a "
                                     f"per-device diagnostic?).  Reduce it "
-                                    f"(e.g. jax.lax.psum over "
-                                    f"igg.AXIS_NAMES) or pass explicit "
-                                    f"out_specs=.")
+                                    f"with a full-mesh collective (jax.lax."
+                                    f"psum/pmax/pmin over igg.AXIS_NAMES) "
+                                    f"or pass explicit out_specs=.")
                         o_specs = out_tree.unflatten(specs_flat)
                 else:
                     o_specs = out_specs

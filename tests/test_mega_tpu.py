@@ -41,7 +41,7 @@ def test_mega_matches_per_step_kernel():
     scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
                 rdz2=1.0 / (dz * dz))
     A = float(params.timestep() * params.lam) / Cp
-    assert mega_supported(T.shape, 8, 6, interpret=False)
+    assert mega_supported(T.shape, 8, 6, interpret=False, dtype=T.dtype)
 
     out = fused_diffusion_megasteps(T, A, n_inner=6, bx=8, **scal)
 

@@ -107,6 +107,23 @@ def test_psum_reduced_diagnostic_is_replicated():
     assert float(norm2) == 6 * 6 * 6 * 8  # 8 devices x 216 ones
 
 
+def test_pmax_reduced_diagnostic_is_replicated():
+    """Max/min-norm diagnostics reduce with pmax/pmin (psum would be
+    numerically wrong); the untaint rule covers them the same way."""
+    from jax import lax
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+
+    @igg.sharded
+    def step(T):
+        return T + 1.0, lax.pmax(T.max(), igg.AXIS_NAMES), \
+            lax.pmin(T.min(), igg.AXIS_NAMES)
+
+    T = igg.ones((6, 6, 6))
+    _, hi, lo = step(T)
+    assert float(hi) == 1.0 and float(lo) == 1.0
+
+
 def test_recreated_closures_share_compiled_program():
     igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
     from igg.models import diffusion3d as d3
