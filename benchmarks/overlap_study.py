@@ -29,6 +29,18 @@ therefore `overlap=False` everywhere.  Treat `hide_communication` as a
 correctness-complete mechanism whose performance case is unproven until a
 multi-chip TPU measurement lands.
 
+Methodology note (round 3): cross-PROCESS compile variance dominates the
+noise on these model steps — XLA's layout/fusion choices differ run to run
+(diffusion plain observed 0.46-0.52 ms, Stokes hidden 0.26-0.42 ms across
+five fresh processes at the same commit), while within-process medians are
+tight.  The committed artifact is the run closest (per-metric) to the
+cross-process medians of five runs; single outlier draws (one run showed
+Stokes hidden at 1.11x plain) must not be read as wins.  Halo assembly in
+the models is pinned per measurement via `update_halo_local(...,
+assembly=)`: "xla" for the radius-1 single-field diffusion step (the select
+chain fuses into the stencil pass), the default Pallas writers for the
+multi-field Stokes/hm3d steps.
+
 Usage: `python benchmarks/overlap_study.py [local_n] [nt] [n_inner]`.
 """
 

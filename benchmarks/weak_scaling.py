@@ -45,14 +45,23 @@ def main():
     nt = int(sys.argv[2]) if len(sys.argv) > 2 else 3
     n_inner = int(sys.argv[3]) if len(sys.argv) > 3 else (20 if platform != "cpu" else 5)
 
+    import os
+
     devices = jax.devices()
     counts = [k for k in (1, 2, 4, 8, 16, 32, 64) if k <= len(devices)]
+    cores = os.cpu_count() or 1
     note(f"platform={platform} available={len(devices)} local={n}^3 "
-         f"counts={counts}")
+         f"counts={counts} host_cores={cores}")
     if platform == "cpu":
-        note("virtual CPU mesh: all devices share one host's cores, so "
-             "efficiency below 1/N is expected and says nothing about ICI "
-             "scaling — this run validates the harness + program structure.")
+        note(f"virtual CPU mesh on {cores} host core(s): N devices "
+             f"time-slice the cores, so the EXPECTED t(N) is t(1)*N/"
+             f"min(N,{cores}) and raw efficiency lands near "
+             f"min(N,{cores})/N (fixed-overhead amortization can beat that "
+             f"ceiling at small N).  The meaningful shared-core check is "
+             f"the normalized efficiency (expected/actual) below staying "
+             f"~1: it verifies the collectives add no pathological "
+             f"serialization.  ICI weak scaling is only measurable on a "
+             f"real slice.")
 
     t1 = None
     for k in counts:
@@ -60,13 +69,18 @@ def main():
         if t1 is None:
             t1 = sec
         eff = t1 / sec
-        emit({
+        rec = {
             "metric": "weak_scaling_efficiency",
             "value": round(eff, 4),
             "unit": "fraction",
             "config": {"local": n, "devices": k, "platform": platform},
             "ms_per_step": round(sec * 1e3, 4),
-        })
+        }
+        if platform == "cpu":
+            ideal = t1 * k / min(k, cores)
+            rec["host_cores"] = cores
+            rec["normalized_efficiency"] = round(ideal / sec, 4)
+        emit(rec)
 
 
 if __name__ == "__main__":

@@ -504,6 +504,45 @@ def _is_tpu(grid) -> bool:
         return False
 
 
+_ASSEMBLY_MODES = (None, "xla", "pallas")
+
+
+def _check_assembly(assembly):
+    if assembly not in _ASSEMBLY_MODES:
+        raise GridError(
+            f"assembly={assembly!r}: expected one of None (default: the "
+            f"in-place Pallas writers on TPU), 'xla' (masked-select/"
+            f"aligned-DUS plans, fusable into a producing stencil), or "
+            f"'pallas' (explicitly request the writers).")
+
+
+def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
+    """Write received (keepdims) halo planes into `out` with the best
+    available strategy: the in-place Pallas writers on TPU (deterministic,
+    see :mod:`igg.ops.halo_write`), the XLA plans elsewhere — or the plan
+    forced by `assembly` ("pallas"/"xla"; see :func:`update_halo` for when
+    each wins).  Unlike the engine-internal writer path, every dim's planes
+    come from `recv` ("ext" sources) — used by
+    :func:`igg.hide_communication`, whose planes are slab-computed arrays
+    rather than slices of the block."""
+    import jax.numpy as jnp
+
+    from .ops.halo_write import halo_write, halo_write_slabs
+
+    _check_assembly(assembly)
+    if assembly == "xla" or not (_is_tpu(grid) or _FORCE_WRITER_INTERPRET):
+        return assemble_planes(out, recv, dims_active)
+    _, use_writer = _writer_dims(out, dims_active, grid)
+    if not use_writer:
+        return assemble_planes(out, recv, dims_active)
+    specs = [(d, "ext", jnp.squeeze(recv[d][0], d),
+              jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
+    interp = _FORCE_WRITER_INTERPRET
+    if any(d == out.ndim - 1 for d, _ in dims_active):
+        return halo_write(out, specs, interpret=interp)
+    return halo_write_slabs(out, specs, interpret=interp)
+
+
 def _writer_dims(A, dims, grid):
     """Partition a field's moving dims for the one-pass Pallas writer path:
     returns `(wraps, use_writer)` where `wraps` are the single-device
@@ -526,7 +565,7 @@ def _writer_dims(A, dims, grid):
     return wraps, use_writer
 
 
-def _update_halo_impl(fields: List, grid) -> Tuple:
+def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
     """Halo update of all fields' local blocks: pack squeezed send planes
     (inner plane `ol-1` / `s-ol`, `/root/reference/src/update_halo.jl:
     386-394`), exchange dimension-sequentially with grouped collectives and
@@ -544,13 +583,15 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
     from .ops.pack import pack_planes_supported, pack_planes
     from .ops.halo_write import halo_write, halo_write_slabs
 
+    _check_assembly(assembly)
     on_tpu = _is_tpu(grid)
     shapes, sends, dims_moving, wraps, writer = [], [], [], [], []
     for A in fields:
         s = A.shape
         dims = moving_dims(active_dims(s, grid), grid)
         w, use_writer = (_writer_dims(A, dims, grid)
-                         if on_tpu or _FORCE_WRITER_INTERPRET
+                         if (on_tpu or _FORCE_WRITER_INTERPRET)
+                         and assembly != "xla"
                          else (frozenset(), False))
         # Send planes are needed for exchanged dims always, and for wrap
         # dims only on the XLA path: the exchange never reads a wrap dim's
@@ -616,16 +657,29 @@ def _update_halo_impl(fields: List, grid) -> Tuple:
 # Public API
 # ---------------------------------------------------------------------------
 
-def update_halo_local(*fields):
+def update_halo_local(*fields, assembly=None):
     """Halo update for use *inside* SPMD code (shard_map / `igg.sharded`),
-    where arrays are per-device local blocks.  Returns updated block(s)."""
+    where arrays are per-device local blocks.  Returns updated block(s).
+
+    `assembly` selects the halo-plane write strategy:
+      - `None` (default) — the in-place Pallas writers on TPU
+        (deterministic, linear in the field count; the right choice for
+        standalone updates and multi-field steps);
+      - `"xla"` — the masked-select/aligned-DUS XLA plans.  When the update
+        is composed with a producing stencil in ONE traced step, XLA can
+        fuse the select chain into the stencil's output pass, beating the
+        writer's extra kernel boundary (measured on the radius-1 single
+        field diffusion step: 0.70 ms vs 1.12 ms at 256^3) — but the plan
+        is a compile lottery for standalone or multi-field programs;
+      - `"pallas"` — force the writers where supported.
+    """
     shared.check_initialized()
     grid = shared.global_grid()
-    out = _update_halo_impl(list(fields), grid)
+    out = _update_halo_impl(list(fields), grid, assembly=assembly)
     return out[0] if len(fields) == 1 else out
 
 
-def update_halo(*fields):
+def update_halo(*fields, assembly=None):
     """Update the halo of the given grid array(s); returns the updated
     array(s) (functional counterpart of the reference's `update_halo!(A...)`,
     `/root/reference/src/update_halo.jl:23-28`).
@@ -635,7 +689,10 @@ def update_halo(*fields):
     subsequent calls for performance, exactly like the reference's
     performance note (`/root/reference/src/update_halo.jl:19-20`).  Inputs
     are donated, so with `T = igg.update_halo(T)` the update is in-place in
-    device HBM (and on tile-aligned grids touches only the boundary slabs).
+    device HBM (and on tile-aligned grids touches only the dirty tiles).
+    See :func:`update_halo_local` for the `assembly` strategies (the default
+    in-place Pallas writers are the right choice here: a standalone update
+    program has no producer to fuse into).
     """
     import jax
 
@@ -644,13 +701,14 @@ def update_halo(*fields):
     local_shapes = [grid.local_shape(A) for A in fields]
     check_fields(grid, fields, local_shapes)
 
-    key = (shared.grid_epoch(),
+    key = (shared.grid_epoch(), assembly,
            tuple((A.shape, str(A.dtype)) for A in fields))
     fn = _compiled.get(key)
     if fn is None:
         specs = tuple(spec_for(A.ndim) for A in fields)
-        sm = jax.shard_map(lambda *fs: _update_halo_impl(list(fs), grid),
-                           mesh=grid.mesh, in_specs=specs, out_specs=specs)
+        sm = jax.shard_map(
+            lambda *fs: _update_halo_impl(list(fs), grid, assembly=assembly),
+            mesh=grid.mesh, in_specs=specs, out_specs=specs)
         fn = jax.jit(sm, donate_argnums=tuple(range(len(fields))))
         _compiled[key] = fn
     out = fn(*fields)

@@ -99,10 +99,14 @@ def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False):
     and XLA can overlap them (ParallelStencil's `@hide_communication`,
     `/root/reference/README.md:9`)."""
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, lam=lam)
+    # assembly="xla": for this radius-1 single-field step, XLA fuses the
+    # halo select chain into the stencil's output pass — measured 0.70 ms
+    # vs 1.12 ms with the (otherwise default) Pallas writer at 256^3.
     if overlap:
         return igg.hide_communication(
-            T, lambda Tb, Cpb: compute_step(Tb, Cpb, **kw), Cp)
-    return igg.update_halo_local(compute_step(T, Cp, **kw))
+            T, lambda Tb, Cpb: compute_step(Tb, Cpb, **kw), Cp,
+            assembly="xla")
+    return igg.update_halo_local(compute_step(T, Cp, **kw), assembly="xla")
 
 
 def _pallas_applicable(use_pallas, T, interpret: bool = False) -> bool:
@@ -187,9 +191,11 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
                                                 rdy2=rdy2, rdz2=rdz2)
 
         def one(T):
+            # assembly="xla": see step() — the select chain fuses into the
+            # radius-1 stencil's output pass, beating the writer here.
             if overlap:
-                return igg.hide_communication(T, comp, A)
-            return igg.update_halo_local(comp(T, A))
+                return igg.hide_communication(T, comp, A, assembly="xla")
+            return igg.update_halo_local(comp(T, A), assembly="xla")
 
         return lax.fori_loop(0, n_inner, lambda _, T: one(T), T)
 

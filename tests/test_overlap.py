@@ -206,3 +206,41 @@ def test_multi_field_negative_stagger_base(eight_devices):
     np.testing.assert_allclose(np.asarray(Vx_h), np.asarray(Vx_p),
                                rtol=1e-12, atol=1e-12)
     igg.finalize_global_grid()
+
+
+def test_writer_assembly_matches_xla(eight_devices):
+    """hide_communication's Pallas-writer assembly (the TPU default) vs the
+    XLA plans, driven on the CPU mesh via the interpret seam — pins the
+    spec building in `igg.halo.assemble_field` (squeeze axes, dim order,
+    corner ownership) that otherwise only runs on real TPU hardware."""
+    from igg import halo
+
+    # Writer-eligible local shape: lane dim aligned (>= 2*128), sublane
+    # tile-aligned.
+    igg.init_global_grid(8, 16, 256, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    A0 = coord_filled((8, 16, 256))
+
+    @igg.sharded
+    def step_xla(A):
+        return igg.hide_communication(A, stencil, assembly="xla")
+
+    xla = np.asarray(step_xla(A0))
+    halo._FORCE_WRITER_INTERPRET = True
+    try:
+        @igg.sharded
+        def step_writer(A):
+            return igg.hide_communication(A, stencil)
+
+        writer = np.asarray(step_writer(A0))
+    finally:
+        halo._FORCE_WRITER_INTERPRET = False
+    np.testing.assert_array_equal(writer, xla)
+    igg.finalize_global_grid()
+
+
+def test_invalid_assembly_rejected(eight_devices):
+    igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+    A = igg.zeros((6, 6, 6))
+    with pytest.raises(igg.GridError, match="assembly="):
+        igg.update_halo(A, assembly="XLA")
