@@ -54,6 +54,60 @@ def main():
             "gbps_ideal_traffic": round(ideal_bytes / sec / 1e9, 1),
             "platform": platform,
         })
+
+    if platform == "tpu":
+        # The x-EXCHANGED (N,1,1) program shape, exercised on the 1-device
+        # self-ring (bit-identical collectives/window structure; real
+        # meshes add ICI latency the K-deep chunks amortize by 1/K):
+        # K-step trapezoidal chunks vs the per-step kernel in a fori loop.
+        from jax import lax
+
+        from igg.ops import fused_diffusion_step
+        from igg.ops.diffusion_trapezoid import (
+            fused_diffusion_trapezoid_steps, trapezoid_supported)
+        from igg.timing import time_steps
+
+        dx, dy, dz = params.spacing()
+        dt = params.timestep()
+        scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                    rdz2=1.0 / (dz * dz))
+
+        def fresh():
+            T, Cp = d3.init_fields(params, dtype=np.float32)
+            return igg.update_halo(T), Cp
+
+        def measure(tag, fn, T, steps):
+            _, sec = time_steps(lambda T: (fn(T),), (T,), n1=nt, n2=3 * nt)
+            sec /= steps   # divide by the steps the program ACTUALLY runs
+            emit({
+                "metric": "pallas_sweep_ms_per_step", "config": tag,
+                "local": n, "value": round(sec * 1e3, 4), "unit": "ms",
+                "gbps_ideal_traffic": round(ideal_bytes / sec / 1e9, 1),
+                "platform": platform,
+            })
+
+        for bx in (8, 16):
+            T, Cp = fresh()
+            A = float(dt * params.lam) / Cp
+            if not trapezoid_supported(grid, T.shape, bx, n_inner, False,
+                                       T.dtype):
+                note(f"trapezoid bx={bx}: unsupported at {n}^3")
+                continue
+            steps = (n_inner // bx) * bx
+            fn = jax.jit(
+                lambda T, bx=bx, A=A, s=steps:
+                fused_diffusion_trapezoid_steps(
+                    T, A, n_inner=s, bx=bx, grid=grid, **scal)[0],
+                donate_argnums=0)
+            measure(f"trapezoid_ring_bx{bx}", fn, T, steps)
+
+        T, Cp = fresh()
+        step = lambda T: fused_diffusion_step(
+            T, Cp, dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam, bx=16)
+        fn = jax.jit(lambda T: lax.fori_loop(0, n_inner,
+                                             lambda _, T: step(T), T),
+                     donate_argnums=0)
+        measure("perstep_ring_bx16", fn, T, n_inner)
     igg.finalize_global_grid()
 
 

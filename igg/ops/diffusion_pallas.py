@@ -54,6 +54,14 @@ output, the exchange is data-independent of the main kernel; semantics match
 composition on periodic/interior ranks; at open-boundary edge ranks the
 physically-meaningless halo cells keep pre-step values).  On a sharded mesh
 this is the fused analog of running the XLA path with `overlap=True`.
+
+**Path selection in** :func:`fused_diffusion_steps` (fastest applicable
+wins): the K-step mega-kernel (`diffusion_mega`, every dim self-wrap,
+0.24 ms/step at 256^3) > K-step trapezoidal chunks
+(`diffusion_trapezoid`, fully-periodic x ring with y/z self-wrap — the
+`(N,1,1)` pod decomposition — 0.29 ms/step, one K-deep slab ppermute pair
+per K steps) > the per-step kernel above (any mesh, 0.52 ms/step;
+`benchmarks/results/pallas_sweep.jsonl`).
 """
 
 from __future__ import annotations
@@ -447,6 +455,28 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
         if mega_supported(T.shape, bx, n_inner, interpret, dtype=T.dtype):
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal)
+
+    # x-exchanged (N,1,1) periodic ring with y/z self-wrap: K-step
+    # trapezoidal chunks — one K-deep slab ppermute pair per K steps, the
+    # loop fused in-kernel (see `diffusion_trapezoid`).  One per-step
+    # kernel step runs FIRST: it consumes (and replaces) whatever is in the
+    # entry halo rows exactly like every other path, establishing the
+    # exchange-fresh window state the trapezoid's validity argument
+    # requires — so this path is bit-equivalent to the per-step path for
+    # ANY input, including never-exchanged arrays.  Remainder steps fall
+    # through to the per-step loop below.
+    from .diffusion_trapezoid import (fused_diffusion_trapezoid_steps,
+                                      trapezoid_supported)
+    if trapezoid_supported(grid, T.shape, bx, n_inner - 1, interpret,
+                           T.dtype):
+        T = fused_diffusion_step(T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
+                                 lam=lam, bx=bx, interpret=interpret)
+        n_inner -= 1
+        T, done = fused_diffusion_trapezoid_steps(
+            T, A, n_inner=n_inner, bx=bx, grid=grid, **scal)
+        n_inner -= done
+        if n_inner == 0:
+            return T
 
     a_slabs = _coef_slabs(A, wrap_yz)  # loop-invariant: sliced once
     init_slabs = _boundary_slabs(T, wrap_yz)

@@ -1,0 +1,313 @@
+"""K-step trapezoidal (halo-deep) diffusion kernel for x-exchanged meshes.
+
+The mega-kernel (`diffusion_mega`) fuses the whole inner time loop into one
+`pallas_call`, but only where every dimension self-wraps on one device.  On
+the practical pod decompositions — `(N,1,1)` with x split over the ring —
+each step needs fresh x halo planes from the neighbors, so the per-step
+kernel re-pays the kernel-boundary HBM round-trip and a collective per step
+(`/root/reference/src/update_halo.jl`'s per-step exchange, likewise).
+
+This module restores K-step fusion there with classic *trapezoidal temporal
+blocking* over the exchanged dimension:
+
+  1. Once per K-step chunk, each device receives the K rows beyond each end
+     of its block (ONE `ppermute` pair moving K-deep slabs — 1/K of the
+     per-step collective count at the same total bytes) and forms the
+     extended buffer `Text = [recv_left | T | recv_right]` of `S0+2K` rows
+     — a contiguous window of the global array.
+  2. ONE `pallas_call` advances K steps on the extended window (same
+     VMEM-resident coefficient, HBM ping-pong, and hand double-buffered DMA
+     as the mega-kernel; y/z halos are in-VMEM self-wrap aliases).  Each
+     step the two outermost rows lose validity — after K steps exactly the
+     device's own `S0` rows (interior AND x halo rows) carry the values the
+     per-step path would produce, bit-for-bit, because every row is updated
+     by the identical stencil arithmetic the neighbor would apply.
+  3. The final step's programs write only that central window to the
+     output; the garbage shoulders are never materialized outside the
+     ping-pong scratch.
+
+Per-chunk overhead vs the ideal: the concat (one extended-buffer write) and
+`2K/S0` redundant shoulder rows of compute — both amortized by K.
+
+Validity requires every device to have both x neighbors, i.e. a fully
+periodic x ring (`periods[0]`, any `dims[0] >= 1` — on one device the ring
+is the self-neighbor ppermute and the path is exercised end-to-end on a
+single chip).  Open x boundaries keep the per-step path: their no-write
+halo semantics (`/root/reference/test/test_update_halo.jl:727-732`) would
+need per-device shape differences that SPMD programs cannot express.
+
+Not available in interpret mode (manual TPU DMA/semaphores), like the
+mega-kernel; callers fall back to the per-step kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from .diffusion_mega import _VMEM_BUDGET
+from .diffusion_pallas import _u_rows
+
+
+def trapezoid_supported(grid, shape, bx: int, n_inner: int,
+                        interpret: bool, dtype) -> bool:
+    """Whether the K=bx trapezoidal chunk kernel applies: compiled mode,
+    fully-periodic x ring, y/z self-wrap (handled in-VMEM), at least one
+    full chunk, the K-slab sends must lie inside the block, and the
+    extended coefficient plus working buffers must fit in VMEM."""
+    import numpy as np
+
+    if interpret or n_inner < bx or bx < 2:
+        return False
+    if not grid.periods[0]:
+        return False
+    for d in (1, 2):
+        if grid.dims[d] != 1 or not grid.periods[d]:
+            return False
+    S0, S1, S2 = shape
+    K = bx
+    ol = grid.ol_of_local(0, shape)
+    if ol < 2 or S0 % bx != 0:
+        return False
+    if S0 - ol - K < 0 or ol + K > S0:  # send slabs inside the block
+        return False
+    S0e = S0 + 2 * K
+    itemsize = np.dtype(dtype).itemsize
+    need = itemsize * (S0e * S1 * S2            # A_ext resident
+                       + 2 * (bx + 2) * S1 * S2   # ext slabs (dbl-buffered)
+                       + 2 * bx * S1 * S2)        # out slabs (dbl-buffered)
+    return need <= _VMEM_BUDGET
+
+
+def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
+            a_vmem, ext2, o2, esems, osems, asem,
+            *, K, bx, nbe, nbo, off, S0e, S1, S2, rdx2, rdy2, rdz2):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    scal = (rdx2, rdy2, rdz2)
+    sl = i % 2
+
+    # One-time: extended coefficient into VMEM.
+    @pl.when((k == 0) & (i == 0))
+    def _():
+        dma = pltpu.make_async_copy(A_hbm, a_vmem, asem)
+        dma.start()
+        dma.wait()
+
+    # Out-write bookkeeping (identical scheme to diffusion_mega._kernel):
+    # drain at each step boundary, else wait the DMA whose slot is reused.
+    @pl.when((i == 0) & (k > 0))
+    def _():
+        pltpu.make_async_copy(o2.at[0], o2.at[0], osems.at[0]).wait()
+        pltpu.make_async_copy(o2.at[1], o2.at[1], osems.at[1]).wait()
+
+    @pl.when(i >= 2)
+    def _():
+        pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
+
+    # Extended-slab fetches (rows [i*bx-1, i*bx+bx+1), CLAMPED at the
+    # buffer ends — the clamped duplicate rows only feed shoulder rows that
+    # are outside the validity trapezoid).  Edge programs fetch their own
+    # segments synchronously; interior programs consume their
+    # predecessor's prefetch and issue the next one.
+    def sync_fetch(src):
+        @pl.when(i == 0)
+        def _():
+            c0 = pltpu.make_async_copy(src.at[0:1], ext2.at[sl, 0:1],
+                                       esems.at[sl])
+            c1 = pltpu.make_async_copy(src.at[0:bx + 1],
+                                       ext2.at[sl, 1:bx + 2],
+                                       esems.at[1 - sl])
+            c0.start(); c1.start(); c0.wait(); c1.wait()
+
+        @pl.when(i == nbe - 1)
+        def _():
+            c0 = pltpu.make_async_copy(src.at[S0e - bx - 1:S0e],
+                                       ext2.at[sl, 0:bx + 1], esems.at[sl])
+            c1 = pltpu.make_async_copy(src.at[S0e - 1:S0e],
+                                       ext2.at[sl, bx + 1:bx + 2],
+                                       esems.at[1 - sl])
+            c0.start(); c1.start(); c0.wait(); c1.wait()
+
+    def prefetch_next(src):
+        @pl.when((i + 1 >= 1) & (i + 1 <= nbe - 2))
+        def _():
+            pltpu.make_async_copy(
+                src.at[pl.ds((i + 1) * bx - 1, bx + 2)],
+                ext2.at[1 - sl], esems.at[1 - sl]).start()
+
+    for cond, src in ((k == 0, Text_hbm),
+                      ((k > 0) & (k % 2 == 1), buf0),
+                      ((k > 0) & (k % 2 == 0), buf1)):
+        @pl.when(cond)
+        def _(src=src):
+            sync_fetch(src)
+            prefetch_next(src)
+
+    @pl.when((i > 0) & (i < nbe - 1))
+    def _():
+        pltpu.make_async_copy(ext2.at[sl], ext2.at[sl], esems.at[sl]).wait()
+
+    # Stencil update in x-row bands + y/z self-wrap assembly (identical
+    # scheme to the mega-kernel's interior programs; every row of the
+    # extended buffer is "interior" — shoulder rows compute garbage that
+    # the shrinking validity never reads back meaningfully).
+    ext = ext2.at[sl]
+    o_vmem = o2.at[sl]
+    c = ext[1:bx + 1]
+    a = a_vmem[pl.ds(i * bx, bx)]
+    if bx > 2:
+        o_vmem[1:bx - 1, 1:-1, 1:-1] = _u_rows(
+            c[0:bx - 2], c[1:bx - 1], c[2:bx], a[1:bx - 1], *scal)
+    o_vmem[0:1, 1:-1, 1:-1] = _u_rows(ext[0:1], c[0:1], c[1:2],
+                                      a[0:1], *scal)
+    o_vmem[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
+        c[bx - 2:bx - 1], c[bx - 1:bx], ext[bx + 1:bx + 2],
+        a[bx - 1:bx], *scal)
+    o_vmem[:, 0:1, 1:-1] = o_vmem[:, S1 - 2:S1 - 1, 1:-1]
+    o_vmem[:, S1 - 1:S1, 1:-1] = o_vmem[:, 1:2, 1:-1]
+    o_vmem[:, :, 0:1] = o_vmem[:, :, S2 - 2:S2 - 1]
+    o_vmem[:, :, S2 - 1:S2] = o_vmem[:, :, 1:2]
+
+    # Async write-back.  Final step: the central window goes to the real
+    # output; shoulder programs park their slab in the (otherwise unused)
+    # next ping-pong buffer so every program starts exactly one out-DMA and
+    # the semaphore accounting stays statically balanced.
+    central = (i >= off) & (i < off + nbo)
+
+    def put(dst, at):
+        pltpu.make_async_copy(o_vmem, dst.at[at], osems.at[sl]).start()
+
+    @pl.when((k == K - 1) & central)
+    def _():
+        put(out_ref, pl.ds((i - off) * bx, bx))
+
+    # Shoulder slabs park in the would-be ping-pong TARGET of this step
+    # (buf0 for even k, buf1 for odd) — the other buffer is this step's
+    # SOURCE, still being read by neighboring programs.
+    @pl.when((k == K - 1) & ~central)
+    def _():
+        put(buf0 if (K - 1) % 2 == 0 else buf1, pl.ds(i * bx, bx))
+
+    @pl.when((k < K - 1) & (k % 2 == 0))
+    def _():
+        put(buf0, pl.ds(i * bx, bx))
+
+    @pl.when((k < K - 1) & (k % 2 == 1))
+    def _():
+        put(buf1, pl.ds(i * bx, bx))
+
+    # Final drain: the last two out DMAs have no successor to wait them.
+    @pl.when((k == K - 1) & (i == nbe - 1))
+    def _():
+        pltpu.make_async_copy(o2.at[1 - sl], o2.at[1 - sl],
+                              osems.at[1 - sl]).wait()
+        pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
+
+
+def _chunk_call(Text, A_ext, S0, *, K, bx, rdx2, rdy2, rdz2):
+    """Advance K steps on the extended buffer; returns the central S0
+    rows."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S0e, S1, S2 = Text.shape
+    assert K == bx, "chunk depth is pinned to the block row count"
+    nbe = S0e // bx
+    nbo = S0 // bx
+    off = 1  # = K // bx
+    kern = partial(_kernel, K=K, bx=bx, nbe=nbe, nbo=nbo, off=off,
+                   S0e=S0e, S1=S1, S2=S2, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+
+    vmas = [getattr(getattr(x, "aval", None), "vma", None)
+            for x in (Text, A_ext)]
+    vma = frozenset().union(*[v for v in vmas if v])
+
+    def shp(rows):
+        s = (rows, S1, S2)
+        return (jax.ShapeDtypeStruct(s, Text.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(s, Text.dtype))
+
+    out, _, _ = pl.pallas_call(
+        kern,
+        grid=(K, nbe),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_shape=[shp(S0), shp(S0e), shp(S0e)],
+        # Text is dead after the k=0 reads; buf1 (first written at k=1)
+        # reuses its buffer.
+        input_output_aliases={0: 2},
+        scratch_shapes=[
+            pltpu.VMEM((S0e, S1, S2), Text.dtype),        # a_vmem
+            pltpu.VMEM((2, bx + 2, S1, S2), Text.dtype),  # ext2
+            pltpu.VMEM((2, bx, S1, S2), Text.dtype),      # o2
+            pltpu.SemaphoreType.DMA((2,)),                # esems
+            pltpu.SemaphoreType.DMA((2,)),                # osems
+            pltpu.SemaphoreType.DMA,                      # asem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=128 * 1024 * 1024,
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(Text, A_ext)
+    return out
+
+
+def _extend_x(T, K, ol, grid):
+    """The `S0 + 2K`-row contiguous global window around this block: K
+    extension rows beyond each end PLUS neighbor-fresh values for the
+    block's own x halo rows, all from one ppermute pair of `(K+1)`-row
+    slabs (self-neighbor on a 1-device ring).
+
+    Replacing the local halo rows (positions `K` and `K+S0-1` of the
+    window) with the neighbors' send-position rows makes the window
+    exchange-fresh at chunk entry — the invariant the trapezoidal validity
+    argument needs.  When the entry halos are already fresh (any state
+    produced by `update_halo`, a model step, or a previous chunk) the
+    replacement is a bit-exact no-op; only a never-exchanged initial array
+    would see its (meaningless) halo values normalized."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..shared import AXIS_NAMES
+
+    S0 = T.shape[0]
+    n = grid.dims[0]
+    axis = AXIS_NAMES[0]
+    # rows [S0-ol-K, S0-ol]: K extension rows + the halo value for the
+    # right neighbor's row 0; rows [ol-1, ol+K): ditto mirrored.
+    left_slab = lax.slice_in_dim(T, S0 - ol - K, S0 - ol + 1, axis=0)
+    right_slab = lax.slice_in_dim(T, ol - 1, ol + K, axis=0)
+    if n > 1:
+        to_right = [(i, (i + 1) % n) for i in range(n)]
+        to_left = [(i, (i - 1) % n) for i in range(n)]
+        left_slab = lax.ppermute(left_slab, axis, to_right)
+        right_slab = lax.ppermute(right_slab, axis, to_left)
+    return jnp.concatenate(
+        [left_slab, lax.slice_in_dim(T, 1, S0 - 1, axis=0), right_slab],
+        axis=0)
+
+
+def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
+                                    grid, rdx2, rdy2, rdz2):
+    """Advance `n_inner` steps in chunks of K=bx trapezoidal kernel calls
+    (plus a per-step remainder handled by the caller; this function runs
+    only the `n_inner // bx` full chunks and returns `(T, steps_done)`)."""
+    from jax import lax
+
+    K = bx
+    ol = grid.ol_of_local(0, T.shape)
+    chunks = n_inner // K
+    A_ext = _extend_x(A, K, ol, grid)   # loop-invariant
+
+    def one(_, T):
+        Text = _extend_x(T, K, ol, grid)
+        return _chunk_call(Text, A_ext, T.shape[0], K=K, bx=bx,
+                           rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+
+    T = lax.fori_loop(0, chunks, one, T)
+    return T, chunks * K

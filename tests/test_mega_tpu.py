@@ -55,3 +55,47 @@ def test_mega_matches_per_step_kernel():
         ref = step(ref)
     scale = float(jnp.max(jnp.abs(ref)))
     assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_trapezoid_matches_per_step_kernel():
+    """The K-step trapezoidal chunk kernel (x-exchanged ring; here the
+    1-device self-ring) must match K applications of the per-step fused
+    kernel on the same block."""
+    import jax
+    import jax.numpy as jnp
+
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_trapezoid import (
+        fused_diffusion_trapezoid_steps, trapezoid_supported)
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    # The trapezoid's validity argument requires exchange-fresh halos at
+    # chunk entry (any state after update_halo or a model step qualifies;
+    # raw init_fields coordinates do not).
+    T = igg.update_halo(T)
+    dx, dy, dz = params.spacing()
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+    A = float(params.timestep() * params.lam) / Cp
+    bx = 8
+    assert trapezoid_supported(grid, T.shape, bx, 2 * bx, False, T.dtype)
+
+    out, done = jax.jit(
+        lambda T, A: fused_diffusion_trapezoid_steps(
+            T, A, n_inner=2 * bx, bx=bx, grid=grid, **scal))(T, A)
+    assert done == 2 * bx
+
+    from igg.ops import fused_diffusion_step
+    dt = params.timestep()
+    ref = T
+    step = jax.jit(lambda T: fused_diffusion_step(
+        T, Cp, dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam, bx=bx))
+    for _ in range(2 * bx):
+        ref = step(ref)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale

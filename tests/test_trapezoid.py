@@ -1,0 +1,82 @@
+"""Trapezoidal K-step chunking: the exchange/window machinery on a real
+multi-device (N,1,1) mesh.
+
+The chunk KERNEL is manual-DMA (TPU-only; equivalence pinned on hardware by
+tests/test_mega_tpu.py::test_trapezoid_matches_per_step_kernel).  What runs
+here is everything around it: the K-deep slab ppermute pair, the
+exchange-fresh window construction (`_extend_x`), and the shrinking-validity
+argument — realized in pure XLA on the 8-device CPU mesh and compared
+against K per-step [stencil + update_halo] applications.
+"""
+
+import numpy as np
+import pytest
+
+import igg
+from igg.ops.diffusion_pallas import _u_rows
+
+
+def _window_steps(Text, A_ext, K, scal):
+    """K plain stencil steps on the extended window (every row interior in
+    x; y/z self-wrap) — the XLA realization of the chunk kernel's
+    per-step update."""
+    from jax import lax
+
+    def step(_, U):
+        S1, S2 = U.shape[1], U.shape[2]
+        U = U.at[1:-1, 1:-1, 1:-1].set(
+            _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1], **scal))
+        U = U.at[:, 0, 1:-1].set(U[:, S1 - 2, 1:-1])
+        U = U.at[:, S1 - 1, 1:-1].set(U[:, 1, 1:-1])
+        U = U.at[:, :, 0].set(U[:, :, S2 - 2])
+        U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+        return U
+
+    return lax.fori_loop(0, K, step, Text)
+
+
+def test_window_chunk_matches_per_step_on_ring():
+    from igg.ops.diffusion_trapezoid import _extend_x
+
+    igg.init_global_grid(12, 8, 8, dimx=8, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    K = 4
+    ol = 2
+    scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
+
+    rng = np.random.default_rng(9)
+    T0 = igg.from_local_blocks(
+        lambda coords, ls: rng.standard_normal(ls) + 10.0 * coords[0],
+        (12, 8, 8))
+    A0 = igg.from_local_blocks(
+        lambda coords, ls: 0.05 + 0.01 * rng.random(ls), (12, 8, 8))
+    # exchange-fresh entry state (the trapezoid's documented requirement)
+    T0, A0 = igg.update_halo(T0, A0)
+
+    @igg.sharded
+    def chunk(T, A):
+        A_ext = _extend_x(A, K, ol, grid)
+        Text = _extend_x(T, K, ol, grid)
+        return _window_steps(Text, A_ext, K, scal)[K:K + T.shape[0]]
+
+    @igg.sharded
+    def per_step(T, A):
+        from jax import lax
+
+        def one(_, T):
+            S1, S2 = T.shape[1], T.shape[2]
+            T = T.at[1:-1, 1:-1, 1:-1].set(
+                _u_rows(T[:-2], T[1:-1], T[2:], A[1:-1], **scal))
+            # y/z self-wrap (single periodic device), then the x exchange
+            T = T.at[:, 0, 1:-1].set(T[:, S1 - 2, 1:-1])
+            T = T.at[:, S1 - 1, 1:-1].set(T[:, 1, 1:-1])
+            T = T.at[:, :, 0].set(T[:, :, S2 - 2])
+            T = T.at[:, :, S2 - 1].set(T[:, :, 1])
+            return igg.update_halo_local(T)
+
+        return lax.fori_loop(0, K, one, T)
+
+    out = np.asarray(chunk(T0, A0))
+    ref = np.asarray(per_step(T0, A0))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-12)
