@@ -7,7 +7,8 @@ Times each model's step on the same grid:
   - hidden   — `igg.hide_communication`: send planes from thin slab
                recomputations, so the full-domain stencil is
                data-independent of every collective;
-  - pallas   — the fused kernel (diffusion and Stokes), where applicable.
+  - pallas   — the fused kernel (diffusion, Stokes, and HM3D), where
+               applicable.
 
 Models: `diffusion3d` (flagship, radius 1) and `stokes3d` (BASELINE config
 5's Stokes solver, radius 2 — run on an overlap-3 grid).  On a 1-device
@@ -53,73 +54,71 @@ import numpy as np
 from common import emit, median_of, note
 
 
-def study_diffusion(n, nt, n_inner, platform):
+def _study(model_run, metric_prefix, supported_fn, grid_kwargs,
+           extra_config, n, nt, n_inner, platform):
+    """Shared study body: time plain / hidden / (pallas where supported)
+    variants of one model's step on a fresh grid and emit the JSON lines."""
     import igg
-    from igg.models import diffusion3d as d3
+    import jax
 
-    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1, quiet=True)
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True, **grid_kwargs)
     grid = igg.get_global_grid()
-    note(f"diffusion3d platform={platform} devices={grid.nprocs} "
+    note(f"{metric_prefix} platform={platform} devices={grid.nprocs} "
          f"dims={grid.dims} local={n}^3")
 
-    variants = [("plain", dict(use_pallas=False, overlap=False)),
-                ("hidden", dict(use_pallas=False, overlap=True))]
-    from igg.ops import pallas_supported
-    import jax
-    T0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
-    if platform == "tpu" and pallas_supported(grid, T0):
-        variants.append(("pallas", dict(use_pallas=True)))
-
-    times = {}
-    for name, kw in variants:
-        sec = median_of(lambda: d3.run(nt, dtype=np.float32,
-                                       n_inner=n_inner, **kw)[1])
-        times[name] = sec
-        emit({
-            "metric": f"diffusion3d_step_{name}",
-            "value": round(sec * 1e3, 4),
-            "unit": "ms",
-            "config": {"local": n, "devices": grid.nprocs,
-                       "dims": list(grid.dims), "platform": platform},
-            "speedup_vs_plain": round(times["plain"] / sec, 3),
-        })
-    igg.finalize_global_grid()
-
-
-def study_stokes(n, nt, n_inner, platform):
-    import igg
-    from igg.models import stokes3d
-
-    # Radius-2 update chain: overlap-3 grid (reference supports overlap>=3,
-    # `/root/reference/test/test_update_halo.jl:188-217`).
-    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
-                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
-    grid = igg.get_global_grid()
-    note(f"stokes3d platform={platform} devices={grid.nprocs} "
-         f"dims={grid.dims} local={n}^3 (overlap 3)")
-
     variants = [("plain", dict(overlap=False)), ("hidden", dict(overlap=True))]
-    from igg.ops import stokes_pallas_supported
-    import jax
-    P0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
-    if platform == "tpu" and stokes_pallas_supported(grid, P0):
+    F0 = jax.ShapeDtypeStruct((n, n, n), np.float32)
+    if platform == "tpu" and supported_fn(grid, F0):
         variants.append(("pallas", dict(use_pallas=True)))
 
     times = {}
     for name, kv in variants:
-        sec = median_of(lambda: stokes3d.run(nt, dtype=np.float32,
-                                             n_inner=n_inner, **kv)[1])
+        sec = median_of(lambda: model_run(nt, dtype=np.float32,
+                                          n_inner=n_inner, **kv)[1])
         times[name] = sec
         emit({
-            "metric": f"stokes3d_iteration_{name}",
+            "metric": f"{metric_prefix}_{name}",
             "value": round(sec * 1e3, 4),
             "unit": "ms",
             "config": {"local": n, "devices": grid.nprocs,
                        "dims": list(grid.dims), "platform": platform,
-                       "overlap_cells": 3},
+                       **extra_config},
             "speedup_vs_plain": round(times["plain"] / sec, 3),
         })
     igg.finalize_global_grid()
+
+
+def study_diffusion(n, nt, n_inner, platform):
+    from igg.models import diffusion3d as d3
+    from igg.ops import pallas_supported
+
+    # d3.run defaults use_pallas="auto"; the plain/hidden variants must
+    # pin the XLA path explicitly.
+    def run(nt, *, use_pallas=False, **kw):
+        return d3.run(nt, use_pallas=use_pallas, **kw)
+
+    _study(run, "diffusion3d_step", pallas_supported, {}, {},
+           n, nt, n_inner, platform)
+
+
+def study_stokes(n, nt, n_inner, platform):
+    from igg.models import stokes3d
+    from igg.ops import stokes_pallas_supported
+
+    # Radius-2 update chain: overlap-3 grid (reference supports overlap>=3,
+    # `/root/reference/test/test_update_halo.jl:188-217`).
+    _study(stokes3d.run, "stokes3d_iteration", stokes_pallas_supported,
+           dict(overlapx=3, overlapy=3, overlapz=3),
+           {"overlap_cells": 3}, n, nt, n_inner, platform)
+
+
+def study_hm3d(n, nt, n_inner, platform):
+    from igg.models import hm3d
+    from igg.ops import hm3d_pallas_supported
+
+    _study(hm3d.run, "hm3d_step", hm3d_pallas_supported, {}, {},
+           n, nt, n_inner, platform)
 
 
 def main():
@@ -137,6 +136,8 @@ def main():
     # grid on CPU smoke runs.
     ns = max(128, n // 2) if platform != "cpu" else n
     study_stokes(ns, nt, max(n_inner // 2, 2), platform)
+    # HM3D (BASELINE config 4's model family) at the diffusion size.
+    study_hm3d(n, nt, n_inner, platform)
 
 
 if __name__ == "__main__":

@@ -57,9 +57,11 @@ def init_fields(params: Params = Params(), dtype=np.float32):
     return Pe, phi
 
 
-def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
-    """The pure coupled update (no halo exchange): radius-1 shift-invariant,
-    usable full-domain and on :func:`igg.hide_communication` slabs."""
+def step_core(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
+    """The coupled increments `(dPe, dphi)` on a window's interior cells:
+    radius-1 shift-invariant, the single source of arithmetic truth shared
+    by the XLA step, the `hide_communication` slabs, and the fused Pallas
+    kernel (`igg.ops.hm3d_pallas`)."""
     k = (phi / phi0) ** npow
     # Face permeabilities (arithmetic mean) and Darcy fluxes on inner faces
     kx = 0.5 * (k[1:, 1:-1, 1:-1] + k[:-1, 1:-1, 1:-1])
@@ -71,25 +73,53 @@ def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
     divq = ((qx[1:, :, :] - qx[:-1, :, :]) / dx
             + (qy[:, 1:, :] - qy[:, :-1, :]) / dy
             + (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
-    from igg.ops import interior_add
-
     inner = (slice(1, -1),) * 3
     # Fluid mass balance: Pe relaxes by Darcy flow + compaction closure;
-    # compaction: porosity responds to the (updated) effective pressure.
-    Pe = interior_add(Pe, dt * (-divq - Pe[inner] * phi[inner] / eta))
-    phi = interior_add(phi, dt * (-phi[inner] * (1.0 - phi[inner])
-                                  * Pe[inner] / eta))
-    return Pe, phi
+    # compaction: porosity responds to the (updated) effective pressure
+    # (Gauss-Seidel coupling).
+    dPe = dt * (-divq - Pe[inner] * phi[inner] / eta)
+    Pe_new = Pe[inner] + dPe
+    dphi = dt * (-phi[inner] * (1.0 - phi[inner]) * Pe_new / eta)
+    return dPe, dphi
+
+
+def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
+    """The pure coupled update (no halo exchange): radius-1 shift-invariant,
+    usable full-domain and on :func:`igg.hide_communication` slabs."""
+    from igg.ops import interior_add
+
+    dPe, dphi = step_core(Pe, phi, dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0,
+                          npow=npow, eta=eta)
+    return interior_add(Pe, dPe), interior_add(phi, dphi)
 
 
 def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
-               overlap: bool = False):
+               overlap: bool = False, use_pallas: bool = False,
+               pallas_interpret: bool = False):
     """One coupled step over per-device local arrays; two mutually-coupled
     fields in one grouped halo update (multi-field pipelining,
     `/root/reference/src/update_halo.jl:19-20`).  `overlap=True`
     restructures with the multi-field :func:`igg.hide_communication`
-    (BASELINE config 4's weak-scaling workload)."""
+    (BASELINE config 4's weak-scaling workload).  `use_pallas=True` runs
+    the whole step (compute + grouped halo update) as ONE fused kernel
+    (`igg.ops.fused_hm3d_step`; self-wrap grids only)."""
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
+    if use_pallas:
+        import jax.numpy as jnp
+
+        from igg.ops import fused_hm3d_step, hm3d_pallas_supported
+
+        grid = igg.get_global_grid()
+        platform_ok = (pallas_interpret or
+                       next(iter(grid.mesh.devices.flat)).platform == "tpu")
+        if (overlap or not platform_ok or Pe.dtype != jnp.float32
+                or not hm3d_pallas_supported(grid, Pe)):
+            raise igg.GridError(
+                "the fused HM3D step requires TPU devices (or "
+                "pallas_interpret=True), a fully-periodic single-device "
+                "overlap-2 grid, f32 fields, x divisible by 4, and "
+                "overlap=False; use the XLA path otherwise.")
+        return fused_hm3d_step(Pe, phi, **kw, interpret=pallas_interpret)
     if overlap:
         return igg.hide_communication(
             (Pe, phi), lambda Pe, phi: compute_step(Pe, phi, **kw))
@@ -97,7 +127,8 @@ def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
-              overlap: bool = False, n_inner: int = 1):
+              overlap: bool = False, n_inner: int = 1,
+              use_pallas: bool = False, pallas_interpret: bool = False):
     from jax import lax
 
     dx, dy, dz = params.spacing()
@@ -109,17 +140,22 @@ def make_step(params: Params = Params(), *, donate: bool = True,
             0, n_inner,
             lambda _, S: local_step(*S, dx=dx, dy=dy, dz=dz, dt=dt,
                                     phi0=phi0, npow=npow, eta=eta,
-                                    overlap=overlap),
+                                    overlap=overlap, use_pallas=use_pallas,
+                                    pallas_interpret=pallas_interpret),
             (Pe, phi))
 
-    return igg.sharded(step, donate_argnums=(0, 1) if donate else ())
+    # check_vma: interpret-mode pallas_call does not propagate shard_map's
+    # varying-manual-axes metadata (same workaround as stokes3d/diffusion3d).
+    return igg.sharded(step, donate_argnums=(0, 1) if donate else (),
+                       check_vma=not (use_pallas and pallas_interpret))
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1):
+        overlap: bool = False, n_inner: int = 1, use_pallas: bool = False):
     """Slope-timed run (see :func:`igg.time_steps`)."""
     Pe, phi = init_fields(params, dtype=dtype)
-    step = make_step(params, overlap=overlap, n_inner=n_inner)
+    step = make_step(params, overlap=overlap, n_inner=n_inner,
+                     use_pallas=use_pallas)
     n1 = max(1, nt // 4)
     state, sec = igg.time_steps(step, (Pe, phi),
                                 n1=n1, n2=max(nt - n1, n1 + 1))

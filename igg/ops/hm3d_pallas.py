@@ -1,0 +1,191 @@
+"""Fused Pallas HM3D step (self-wrap single-device grids).
+
+One `pallas_call` performs the full coupled hydro-mechanical step —
+porosity-dependent (cubic) face permeabilities, Darcy fluxes, the effective
+pressure update, the Gauss-Seidel-coupled porosity update, AND the grouped
+halo update of both fields — reading Pe and phi once each and writing them
+once each (the ideal 2+2 array traffic).  The XLA composition
+(`hm3d.local_step`: `compute_step` + grouped `update_halo_local(Pe, phi)`)
+pays ~10 HBM-bound fusion passes for the same step.
+
+This extends the native-kernel tier (the reference's ">10x" claim for
+custom kernels over array broadcasting, `/root/reference/README.md:161`)
+to BASELINE config 4's model family; `diffusion_pallas`/`stokes_pallas`
+cover configs 1-3 and 5.
+
+Measured on v5e at 256^3 f32 (median-of-3, 100-step dispatches):
+**0.66 ms/step vs 2.92 for the XLA composition — 4.5x** (the largest
+native-tier gain of the three model kernels: the nonlinear per-step
+`(phi/phi0)^n` permeabilities and two coupled interior updates cost the
+XLA path many extra HBM passes that all fuse here), matching the XLA path
+to float32 rounding; `benchmarks/results/overlap_study.jsonl`.
+
+Structure (mirrors `stokes_pallas`, radius-1 two-field variant):
+  - grid over x-slabs of `bx` rows; each program reads its slab of Pe and
+    phi plus one margin row per side (single-row block refs, modular index
+    maps — edge programs read wrapped rows whose results land only in halo
+    rows overwritten by the halo phase);
+  - the slab arithmetic is LITERALLY `hm3d.step_core` — one source of
+    arithmetic truth with the XLA path;
+  - x halo planes cross program boundaries: precomputed in XLA from the two
+    3-row x-end windows (same `step_core`, contiguous dim-0 slices) and
+    written by the edge programs; y/z halos are in-VMEM self-wrap aliases
+    (overlap 2).
+
+Requirements: single device, all dimensions periodic, overlap 2, equal
+float dtypes.  Other configurations fall back to the XLA path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+# See stokes_pallas._VMEM_LIMIT: a tight scoped-vmem budget steers Mosaic
+# toward better DMA/compute interleaving for slab kernels of this shape.
+_VMEM_LIMIT = 32 * 1024 * 1024
+
+
+def hm3d_pallas_supported(grid, Pe) -> bool:
+    """Whether the fused step applies: self-wrap fully-periodic
+    single-device grid with overlap 2, unstaggered local blocks large
+    enough to slab."""
+    if tuple(grid.dims) != (1, 1, 1) or not all(bool(p) for p in grid.periods):
+        return False
+    if grid.overlaps != (2, 2, 2) or Pe.ndim != 3:
+        return False
+    s = tuple(grid.local_shape_any(Pe))
+    if s != tuple(grid.nxyz):
+        return False
+    return s[0] % 4 == 0 and s[0] >= 8 and s[1] >= 8 and s[2] >= 8
+
+
+def _windows(Pe, phi, kw):
+    """The updated x halo planes from the two 3-row x-end windows: send
+    positions `s-ol = S0-2` (window rows [S0-3, S0)) and `ol-1 = 1`
+    (rows [0, 3))."""
+    from jax import lax
+
+    from ..models.hm3d import step_core
+
+    S0 = Pe.shape[0]
+
+    def win(lo, hi):
+        cut = lambda A: lax.slice_in_dim(A, lo, hi, axis=0)
+        wPe, wphi = cut(Pe), cut(phi)
+        dPe, dphi = step_core(wPe, wphi, **kw)
+        # Full (S1,S2) planes: interior updated, y/z edge cells stale —
+        # exactly the XLA path's send planes; the kernel's y/z wraps
+        # overwrite the edges (sequential-dimension semantics).
+        pe_pl = wPe[1].at[1:-1, 1:-1].add(dPe[0])
+        phi_pl = wphi[1].at[1:-1, 1:-1].add(dphi[0])
+        return pe_pl, phi_pl
+
+    first = win(S0 - 3, S0)   # updated global row S0-2
+    last = win(0, 3)          # updated global row 1
+    return first, last
+
+
+def _kernel(*refs, bx, nb, kw):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from ..models.hm3d import step_core
+
+    it = iter(refs)
+    m1, cPe, p1 = next(it), next(it), next(it)
+    ePe = jnp.concatenate([m1[:], cPe[:], p1[:]], axis=0)
+    m1, cphi, p1 = next(it), next(it), next(it)
+    ephi = jnp.concatenate([m1[:], cphi[:], p1[:]], axis=0)
+    pef, phif = next(it), next(it)      # first planes (row 0)
+    pel, phil = next(it), next(it)      # last planes (row S0-1)
+    oPe, ophi = next(it), next(it)
+
+    dPe, dphi = step_core(ePe, ephi, **kw)
+
+    # Out rows j <-> ext rows j+1; increments are on the ext interior
+    # (offset 1), so out row j <-> increment row j.
+    oPe[:] = ePe[1:1 + bx]
+    oPe[:, 1:-1, 1:-1] = ePe[1:1 + bx, 1:-1, 1:-1] + dPe[0:bx]
+    ophi[:] = ephi[1:1 + bx]
+    ophi[:, 1:-1, 1:-1] = ephi[1:1 + bx, 1:-1, 1:-1] + dphi[0:bx]
+
+    i = pl.program_id(0)
+
+    # x halo planes first (dimension-sequential order: y/z own the shared
+    # corner/edge cells via the wraps below).
+    @pl.when(i == 0)
+    def _():
+        oPe[0:1] = pef[:][None]
+        ophi[0:1] = phif[:][None]
+
+    @pl.when(i == nb - 1)
+    def _():
+        oPe[bx - 1:bx] = pel[:][None]
+        ophi[bx - 1:bx] = phil[:][None]
+
+    # y then z self-wrap (overlap 2).
+    for o_ref in (oPe, ophi):
+        s1, s2 = o_ref.shape[1], o_ref.shape[2]
+        o_ref[:, 0:1, :] = o_ref[:, s1 - 2:s1 - 1, :]
+        o_ref[:, s1 - 1:s1, :] = o_ref[:, 1:2, :]
+        o_ref[:, :, 0:1] = o_ref[:, :, s2 - 2:s2 - 1]
+        o_ref[:, :, s2 - 1:s2] = o_ref[:, :, 1:2]
+
+
+def fused_hm3d_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
+                    bx: int = 8, interpret: bool = False):
+    """One fused HM3D step `(Pe, phi) -> (Pe', phi')` with halo maintenance
+    included, on a self-wrap grid (see module docstring).  Matches
+    `hm3d.local_step(..., overlap=False)` to Mosaic-vs-XLA rounding."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    S0, S1, S2 = Pe.shape
+    while S0 % bx != 0:
+        bx //= 2
+    if bx < 4:
+        raise ValueError(f"x size {S0} not divisible into slabs of >= 4 rows")
+    nb = S0 // bx
+    kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
+
+    first, last = _windows(Pe, phi, kw)
+
+    operands, in_specs = [], []
+    for F in (Pe, phi):
+        yz = F.shape[1:]
+        for r in (-1, "c", bx):
+            operands.append(F)
+            if r == "c":
+                in_specs.append(pl.BlockSpec((bx, *yz),
+                                             lambda i: (i, 0, 0)))
+            else:
+                in_specs.append(pl.BlockSpec(
+                    (1, *yz),
+                    lambda i, rr=r: ((i * bx + rr) % S0, 0, 0)))
+    for pln in (*first, *last):
+        operands.append(pln)
+        in_specs.append(pl.BlockSpec(pln.shape, lambda i: (0, 0)))
+
+    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
+    vma = frozenset().union(*[v for v in vmas if v])
+
+    def shp(F):
+        return (jax.ShapeDtypeStruct(F.shape, F.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(F.shape, F.dtype))
+
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT,
+            dimension_semantics=("parallel",))
+
+    return pl.pallas_call(
+        partial(_kernel, bx=bx, nb=nb, kw=kw),
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0))] * 2,
+        out_shape=[shp(Pe), shp(phi)],
+        interpret=interpret,
+        **kwargs,
+    )(*operands)
