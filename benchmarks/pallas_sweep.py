@@ -86,20 +86,22 @@ def main():
                 "platform": platform,
             })
 
-        for bx in (8, 16):
+        for bx, y_ext in ((8, False), (16, False), (8, True)):
             T, Cp = fresh()
             A = float(dt * params.lam) / Cp
             if not trapezoid_supported(grid, T.shape, bx, n_inner, False,
-                                       T.dtype):
+                                       T.dtype, force_y_ext=y_ext):
                 note(f"trapezoid bx={bx}: unsupported at {n}^3")
                 continue
             steps = (n_inner // bx) * bx
             fn = jax.jit(
-                lambda T, bx=bx, A=A, s=steps:
+                lambda T, bx=bx, A=A, s=steps, ye=y_ext:
                 fused_diffusion_trapezoid_steps(
-                    T, A, n_inner=s, bx=bx, grid=grid, **scal)[0],
+                    T, A, n_inner=s, bx=bx, grid=grid, force_y_ext=ye,
+                    **scal)[0],
                 donate_argnums=0)
-            measure(f"trapezoid_ring_bx{bx}", fn, T, steps)
+            tag = "torus" if y_ext else "ring"
+            measure(f"trapezoid_{tag}_bx{bx}", fn, T, steps)
 
         T, Cp = fresh()
         step = lambda T: fused_diffusion_step(

@@ -58,9 +58,10 @@ this is the fused analog of running the XLA path with `overlap=True`.
 **Path selection in** :func:`fused_diffusion_steps` (fastest applicable
 wins): the K-step mega-kernel (`diffusion_mega`, every dim self-wrap,
 0.24 ms/step at 256^3) > K-step trapezoidal chunks
-(`diffusion_trapezoid`, fully-periodic x ring with y/z self-wrap — the
-`(N,1,1)` pod decomposition — 0.29 ms/step, one K-deep slab ppermute pair
-per K steps) > the per-step kernel above (any mesh, 0.52 ms/step;
+(`diffusion_trapezoid`, fully-periodic rings with z self-wrap — 0.29
+ms/step on the `(N,1,1)` pod decomposition, 0.40 on `(N,M,1)` with both
+dims extended; one K-deep slab ppermute pair per exchanged dim per K
+steps) > the per-step kernel above (any mesh, 0.52 ms/step;
 `benchmarks/results/pallas_sweep.jsonl`).
 """
 
@@ -456,8 +457,9 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal)
 
-    # x-exchanged (N,1,1) periodic ring with y/z self-wrap: K-step
-    # trapezoidal chunks — one K-deep slab ppermute pair per K steps, the
+    # Exchanged periodic meshes — (N,1,1) x ring, or (N,M,1) with the
+    # y ring extended too — with z self-wrap: K-step trapezoidal chunks,
+    # one K-deep slab ppermute pair per exchanged dim per K steps, the
     # loop fused in-kernel (see `diffusion_trapezoid`).  One per-step
     # kernel step runs FIRST: it consumes (and replaces) whatever is in the
     # entry halo rows exactly like every other path, establishing the

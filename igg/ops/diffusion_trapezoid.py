@@ -1,40 +1,51 @@
-"""K-step trapezoidal (halo-deep) diffusion kernel for x-exchanged meshes.
+"""K-step trapezoidal (halo-deep) diffusion kernel for exchanged meshes.
 
 The mega-kernel (`diffusion_mega`) fuses the whole inner time loop into one
 `pallas_call`, but only where every dimension self-wraps on one device.  On
-the practical pod decompositions — `(N,1,1)` with x split over the ring —
-each step needs fresh x halo planes from the neighbors, so the per-step
-kernel re-pays the kernel-boundary HBM round-trip and a collective per step
+the practical pod decompositions — `(N,1,1)` and `(N,M,1)` with the grid
+split over the ring/torus — each step needs fresh halo planes from the
+neighbors, so the per-step kernel re-pays the kernel-boundary HBM
+round-trip and a collective per step
 (`/root/reference/src/update_halo.jl`'s per-step exchange, likewise).
 
 This module restores K-step fusion there with classic *trapezoidal temporal
-blocking* over the exchanged dimension:
+blocking* over the exchanged dimension(s):
 
   1. Once per K-step chunk, each device receives the K rows beyond each end
-     of its block (ONE `ppermute` pair moving K-deep slabs — 1/K of the
-     per-step collective count at the same total bytes) and forms the
-     extended buffer `Text = [recv_left | T | recv_right]` of `S0+2K` rows
-     — a contiguous window of the global array.
+     of its block along every exchanged dimension (ONE `ppermute` pair per
+     dim moving K-deep slabs — 1/K of the per-step collective count at the
+     same total bytes) and forms the extended buffer — a contiguous window
+     of the global array.  For `(N,M,1)` the extensions are built
+     dimension-sequentially: the y slabs are cut from the x-EXTENDED
+     buffer, so the corner regions arrive via the y-neighbor's own x
+     extension (the same sequential-exchange trick the halo engine uses for
+     corner propagation, `/root/reference/src/update_halo.jl:36,130`).
   2. ONE `pallas_call` advances K steps on the extended window (same
      VMEM-resident coefficient, HBM ping-pong, and hand double-buffered DMA
-     as the mega-kernel; y/z halos are in-VMEM self-wrap aliases).  Each
-     step the two outermost rows lose validity — after K steps exactly the
-     device's own `S0` rows (interior AND x halo rows) carry the values the
-     per-step path would produce, bit-for-bit, because every row is updated
-     by the identical stencil arithmetic the neighbor would apply.
+     as the mega-kernel; wrap dims keep their in-VMEM self-wrap aliases).
+     Each step the outermost rows of every extended dimension lose
+     validity — after K steps exactly the device's own block (interior AND
+     halo rows) carries the values the per-step path would produce,
+     bit-for-bit, because every row is updated by the identical stencil
+     arithmetic the neighbor would apply.
   3. The final step's programs write only that central window to the
      output; the garbage shoulders are never materialized outside the
      ping-pong scratch.
 
 Per-chunk overhead vs the ideal: the concat (one extended-buffer write) and
-`2K/S0` redundant shoulder rows of compute — both amortized by K.
+the redundant shoulder compute (`2K/S` per extended dim) — both amortized
+by K.
 
-Validity requires every device to have both x neighbors, i.e. a fully
-periodic x ring (`periods[0]`, any `dims[0] >= 1` — on one device the ring
-is the self-neighbor ppermute and the path is exercised end-to-end on a
-single chip).  Open x boundaries keep the per-step path: their no-write
-halo semantics (`/root/reference/test/test_update_halo.jl:727-732`) would
-need per-device shape differences that SPMD programs cannot express.
+Validity requires every device to have both neighbors along each extended
+dimension, i.e. fully periodic rings (`periods[d]`, any `dims[d] >= 1` —
+on one device the ring is the self-neighbor ppermute and the path is
+exercised end-to-end on a single chip).  Open boundaries keep the per-step
+path: their no-write halo semantics
+(`/root/reference/test/test_update_halo.jl:727-732`) would need per-device
+shape differences that SPMD programs cannot express.  The dispatcher in
+`fused_diffusion_steps` also runs one per-step kernel step BEFORE the
+chunks, which consumes never-exchanged entry halos exactly like every
+other path (bit-equivalence for ANY input).
 
 Not available in interpret mode (manual TPU DMA/semaphores), like the
 mega-kernel; callers fall back to the per-step kernel.
@@ -48,39 +59,62 @@ from .diffusion_mega import _VMEM_BUDGET
 from .diffusion_pallas import _u_rows
 
 
+def _mode(grid):
+    """(x_ok, y_ext) — x must be a periodic ring; y is either a self-wrap
+    (1 periodic device) or an extended periodic ring; z must self-wrap."""
+    x_ok = bool(grid.periods[0])
+    z_ok = grid.dims[2] == 1 and bool(grid.periods[2])
+    if not (x_ok and z_ok) or not grid.periods[1]:
+        return False, False
+    return True, grid.dims[1] > 1
+
+
 def trapezoid_supported(grid, shape, bx: int, n_inner: int,
-                        interpret: bool, dtype) -> bool:
+                        interpret: bool, dtype,
+                        force_y_ext=None) -> bool:
     """Whether the K=bx trapezoidal chunk kernel applies: compiled mode,
-    fully-periodic x ring, y/z self-wrap (handled in-VMEM), at least one
-    full chunk, the K-slab sends must lie inside the block, and the
-    extended coefficient plus working buffers must fit in VMEM."""
+    fully-periodic x ring (and y ring when y is split), z self-wrap, at
+    least one full chunk, the K-slab sends must lie inside the block, and
+    the extended coefficient plus working buffers must fit in VMEM."""
     import numpy as np
 
     if interpret or n_inner < bx or bx < 2:
         return False
-    if not grid.periods[0]:
+    ok, y_ext = _mode(grid)
+    if not ok:
         return False
-    for d in (1, 2):
-        if grid.dims[d] != 1 or not grid.periods[d]:
-            return False
+    if force_y_ext is not None:
+        y_ext = force_y_ext
     S0, S1, S2 = shape
     K = bx
-    ol = grid.ol_of_local(0, shape)
-    if ol < 2 or S0 % bx != 0:
+    olx = grid.ol_of_local(0, shape)
+    if olx < 2 or S0 % bx != 0:
         return False
-    if S0 - ol - K < 0 or ol + K > S0:  # send slabs inside the block
+    if S0 - olx - K < 0 or olx + K > S0:  # x send slabs inside the block
         return False
+    S1e = S1
+    if y_ext:
+        oly = grid.ol_of_local(1, shape)
+        # 8-aligned K and S1 keep the extended span and the caller's
+        # central-window XLA slice on sublane-tile boundaries; the y send
+        # slabs must lie inside the block.
+        if oly < 2 or K % 8 != 0 or S1 % 8 != 0:
+            return False
+        if S1 - oly - K < 0 or oly + K > S1:
+            return False
+        S1e = S1 + 2 * K
     S0e = S0 + 2 * K
     itemsize = np.dtype(dtype).itemsize
-    need = itemsize * (S0e * S1 * S2            # A_ext resident
-                       + 2 * (bx + 2) * S1 * S2   # ext slabs (dbl-buffered)
-                       + 2 * bx * S1 * S2)        # out slabs (dbl-buffered)
+    need = itemsize * (S0e * S1e * S2             # A_ext resident
+                       + 2 * (bx + 2) * S1e * S2    # ext slabs (dbl-buffered)
+                       + 2 * bx * S1e * S2)         # out slabs (dbl-buffered)
     return need <= _VMEM_BUDGET
 
 
 def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
             a_vmem, ext2, o2, esems, osems, asem,
-            *, K, bx, nbe, nbo, off, S0e, S1, S2, rdx2, rdy2, rdz2):
+            *, K, bx, nbe, nbo, off, S0e, S1e, S2, y_ext,
+            rdx2, rdy2, rdz2):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -151,10 +185,10 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
     def _():
         pltpu.make_async_copy(ext2.at[sl], ext2.at[sl], esems.at[sl]).wait()
 
-    # Stencil update in x-row bands + y/z self-wrap assembly (identical
-    # scheme to the mega-kernel's interior programs; every row of the
-    # extended buffer is "interior" — shoulder rows compute garbage that
-    # the shrinking validity never reads back meaningfully).
+    # Stencil update in x-row bands (identical scheme to the mega-kernel's
+    # interior programs; every row of the extended buffer is "interior" —
+    # shoulder rows compute garbage that the shrinking validity never reads
+    # back into valid cells).
     ext = ext2.at[sl]
     o_vmem = o2.at[sl]
     c = ext[1:bx + 1]
@@ -167,8 +201,11 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
     o_vmem[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
         c[bx - 2:bx - 1], c[bx - 1:bx], ext[bx + 1:bx + 2],
         a[bx - 1:bx], *scal)
-    o_vmem[:, 0:1, 1:-1] = o_vmem[:, S1 - 2:S1 - 1, 1:-1]
-    o_vmem[:, S1 - 1:S1, 1:-1] = o_vmem[:, 1:2, 1:-1]
+    if not y_ext:
+        # y self-wrap; in extended-y mode the edge rows are shoulder cells
+        # whose (garbage) values the validity argument never reads back.
+        o_vmem[:, 0:1, 1:-1] = o_vmem[:, S1e - 2:S1e - 1, 1:-1]
+        o_vmem[:, S1e - 1:S1e, 1:-1] = o_vmem[:, 1:2, 1:-1]
     o_vmem[:, :, 0:1] = o_vmem[:, :, S2 - 2:S2 - 1]
     o_vmem[:, :, S2 - 1:S2] = o_vmem[:, :, 1:2]
 
@@ -176,6 +213,11 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
     # output; shoulder programs park their slab in the (otherwise unused)
     # next ping-pong buffer so every program starts exactly one out-DMA and
     # the semaphore accounting stays statically balanced.
+    # All puts are FULL slabs: every semaphore wait above assumes the
+    # full-slab byte count, so a narrower (y-windowed) final DMA would
+    # unbalance the accounting and hang the chip.  In y-extended mode the
+    # output therefore carries the extended y span and the caller slices
+    # the central window in XLA.
     central = (i >= off) & (i < off + nbo)
 
     def put(dst, at):
@@ -208,27 +250,29 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
         pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
 
 
-def _chunk_call(Text, A_ext, S0, *, K, bx, rdx2, rdy2, rdz2):
-    """Advance K steps on the extended buffer; returns the central S0
-    rows."""
+def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2):
+    """Advance K steps on the extended buffer; returns the central
+    `out_shape3` window."""
     import jax
+    from jax import lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    S0e, S1, S2 = Text.shape
+    S0e, S1e, S2 = Text.shape
+    S0, S1o, _ = out_shape3
     assert K == bx, "chunk depth is pinned to the block row count"
     nbe = S0e // bx
     nbo = S0 // bx
     off = 1  # = K // bx
     kern = partial(_kernel, K=K, bx=bx, nbe=nbe, nbo=nbo, off=off,
-                   S0e=S0e, S1=S1, S2=S2, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+                   S0e=S0e, S1e=S1e, S2=S2, y_ext=y_ext,
+                   rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
 
     vmas = [getattr(getattr(x, "aval", None), "vma", None)
             for x in (Text, A_ext)]
     vma = frozenset().union(*[v for v in vmas if v])
 
-    def shp(rows):
-        s = (rows, S1, S2)
+    def shp(s):
         return (jax.ShapeDtypeStruct(s, Text.dtype, vma=vma) if vma
                 else jax.ShapeDtypeStruct(s, Text.dtype))
 
@@ -238,75 +282,91 @@ def _chunk_call(Text, A_ext, S0, *, K, bx, rdx2, rdy2, rdz2):
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
-        out_shape=[shp(S0), shp(S0e), shp(S0e)],
+        out_shape=[shp((S0, S1e, S2)), shp(Text.shape), shp(Text.shape)],
         # Text is dead after the k=0 reads; buf1 (first written at k=1)
         # reuses its buffer.
         input_output_aliases={0: 2},
         scratch_shapes=[
-            pltpu.VMEM((S0e, S1, S2), Text.dtype),        # a_vmem
-            pltpu.VMEM((2, bx + 2, S1, S2), Text.dtype),  # ext2
-            pltpu.VMEM((2, bx, S1, S2), Text.dtype),      # o2
-            pltpu.SemaphoreType.DMA((2,)),                # esems
-            pltpu.SemaphoreType.DMA((2,)),                # osems
-            pltpu.SemaphoreType.DMA,                      # asem
+            pltpu.VMEM(Text.shape, Text.dtype),             # a_vmem
+            pltpu.VMEM((2, bx + 2, S1e, S2), Text.dtype),   # ext2
+            pltpu.VMEM((2, bx, S1e, S2), Text.dtype),       # o2
+            pltpu.SemaphoreType.DMA((2,)),                  # esems
+            pltpu.SemaphoreType.DMA((2,)),                  # osems
+            pltpu.SemaphoreType.DMA,                        # asem
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=128 * 1024 * 1024,
             dimension_semantics=("arbitrary", "arbitrary")),
     )(Text, A_ext)
+    if y_ext:
+        # Central y window (tile-aligned K offset: a cheap slab slice).
+        out = lax.slice_in_dim(out, K, K + S1o, axis=1)
     return out
 
 
-def _extend_x(T, K, ol, grid):
-    """The `S0 + 2K`-row contiguous global window around this block: K
-    extension rows beyond each end PLUS neighbor-fresh values for the
-    block's own x halo rows, all from one ppermute pair of `(K+1)`-row
-    slabs (self-neighbor on a 1-device ring).
+def _extend_dim(T, K, ol, grid, d):
+    """The `size + 2K` contiguous global window along dim `d`: K extension
+    rows beyond each end PLUS neighbor-fresh values for the block's own
+    halo rows, all from one ppermute pair of `(K+1)`-row slabs
+    (self-neighbor on a 1-device ring).
 
-    Replacing the local halo rows (positions `K` and `K+S0-1` of the
-    window) with the neighbors' send-position rows makes the window
-    exchange-fresh at chunk entry — the invariant the trapezoidal validity
-    argument needs.  When the entry halos are already fresh (any state
-    produced by `update_halo`, a model step, or a previous chunk) the
-    replacement is a bit-exact no-op; only a never-exchanged initial array
-    would see its (meaningless) halo values normalized."""
+    Replacing the local halo rows with the neighbors' send-position rows
+    makes the window exchange-fresh at chunk entry — the invariant the
+    trapezoidal validity argument needs.  When the entry halos are already
+    fresh (any state produced by `update_halo`, a model step, or a previous
+    chunk) the replacement is a bit-exact no-op."""
     import jax.numpy as jnp
     from jax import lax
 
     from ..shared import AXIS_NAMES
 
-    S0 = T.shape[0]
-    n = grid.dims[0]
-    axis = AXIS_NAMES[0]
-    # rows [S0-ol-K, S0-ol]: K extension rows + the halo value for the
-    # right neighbor's row 0; rows [ol-1, ol+K): ditto mirrored.
-    left_slab = lax.slice_in_dim(T, S0 - ol - K, S0 - ol + 1, axis=0)
-    right_slab = lax.slice_in_dim(T, ol - 1, ol + K, axis=0)
+    S = T.shape[d]
+    n = grid.dims[d]
+    axis = AXIS_NAMES[d]
+    # rows [S-ol-K, S-ol]: K extension rows + the halo value for the
+    # next neighbor's row 0; rows [ol-1, ol+K): ditto mirrored.
+    left_slab = lax.slice_in_dim(T, S - ol - K, S - ol + 1, axis=d)
+    right_slab = lax.slice_in_dim(T, ol - 1, ol + K, axis=d)
     if n > 1:
         to_right = [(i, (i + 1) % n) for i in range(n)]
         to_left = [(i, (i - 1) % n) for i in range(n)]
         left_slab = lax.ppermute(left_slab, axis, to_right)
         right_slab = lax.ppermute(right_slab, axis, to_left)
     return jnp.concatenate(
-        [left_slab, lax.slice_in_dim(T, 1, S0 - 1, axis=0), right_slab],
-        axis=0)
+        [left_slab, lax.slice_in_dim(T, 1, S - 1, axis=d), right_slab],
+        axis=d)
+
+
+def _extend(T, K, grid, shape, y_ext):
+    """x extension, then (for split y) the y extension OF the x-extended
+    buffer — corners arrive via the y-neighbor's own x extension."""
+    Text = _extend_dim(T, K, grid.ol_of_local(0, shape), grid, 0)
+    if y_ext:
+        Text = _extend_dim(Text, K, grid.ol_of_local(1, shape), grid, 1)
+    return Text
 
 
 def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
-                                    grid, rdx2, rdy2, rdz2):
+                                    grid, rdx2, rdy2, rdz2,
+                                    force_y_ext=None):
     """Advance `n_inner` steps in chunks of K=bx trapezoidal kernel calls
     (plus a per-step remainder handled by the caller; this function runs
-    only the `n_inner // bx` full chunks and returns `(T, steps_done)`)."""
+    only the `n_inner // bx` full chunks and returns `(T, steps_done)`).
+    `force_y_ext` overrides the mesh-derived y mode (benchmarking the
+    `(N,M,1)` program shape on a 1-device self-torus)."""
     from jax import lax
 
     K = bx
-    ol = grid.ol_of_local(0, T.shape)
+    shape = T.shape
+    _, y_ext = _mode(grid)
+    if force_y_ext is not None:
+        y_ext = force_y_ext
     chunks = n_inner // K
-    A_ext = _extend_x(A, K, ol, grid)   # loop-invariant
+    A_ext = _extend(A, K, grid, shape, y_ext)   # loop-invariant
 
     def one(_, T):
-        Text = _extend_x(T, K, ol, grid)
-        return _chunk_call(Text, A_ext, T.shape[0], K=K, bx=bx,
+        Text = _extend(T, K, grid, shape, y_ext)
+        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, y_ext=y_ext,
                            rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
 
     T = lax.fori_loop(0, chunks, one, T)

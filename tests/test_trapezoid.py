@@ -4,7 +4,7 @@ multi-device (N,1,1) mesh.
 The chunk KERNEL is manual-DMA (TPU-only; equivalence pinned on hardware by
 tests/test_mega_tpu.py::test_trapezoid_matches_per_step_kernel).  What runs
 here is everything around it: the K-deep slab ppermute pair, the
-exchange-fresh window construction (`_extend_x`), and the shrinking-validity
+exchange-fresh window construction (`_extend_dim`), and the shrinking-validity
 argument — realized in pure XLA on the 8-device CPU mesh and compared
 against K per-step [stencil + update_halo] applications.
 """
@@ -36,7 +36,7 @@ def _window_steps(Text, A_ext, K, scal):
 
 
 def test_window_chunk_matches_per_step_on_ring():
-    from igg.ops.diffusion_trapezoid import _extend_x
+    from igg.ops.diffusion_trapezoid import _extend_dim
 
     igg.init_global_grid(12, 8, 8, dimx=8, dimy=1, dimz=1,
                          periodx=1, periody=1, periodz=1, quiet=True)
@@ -56,8 +56,8 @@ def test_window_chunk_matches_per_step_on_ring():
 
     @igg.sharded
     def chunk(T, A):
-        A_ext = _extend_x(A, K, ol, grid)
-        Text = _extend_x(T, K, ol, grid)
+        A_ext = _extend_dim(A, K, ol, grid, 0)
+        Text = _extend_dim(T, K, ol, grid, 0)
         return _window_steps(Text, A_ext, K, scal)[K:K + T.shape[0]]
 
     @igg.sharded
@@ -71,6 +71,68 @@ def test_window_chunk_matches_per_step_on_ring():
             # y/z self-wrap (single periodic device), then the x exchange
             T = T.at[:, 0, 1:-1].set(T[:, S1 - 2, 1:-1])
             T = T.at[:, S1 - 1, 1:-1].set(T[:, 1, 1:-1])
+            T = T.at[:, :, 0].set(T[:, :, S2 - 2])
+            T = T.at[:, :, S2 - 1].set(T[:, :, 1])
+            return igg.update_halo_local(T)
+
+        return lax.fori_loop(0, K, one, T)
+
+    out = np.asarray(chunk(T0, A0))
+    ref = np.asarray(per_step(T0, A0))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-12)
+
+
+def _window_steps_2d(Text, A_ext, K, scal):
+    """K stencil steps on a doubly-extended window (x AND y extended; z
+    self-wrap)."""
+    from jax import lax
+
+    def step(_, U):
+        S2 = U.shape[2]
+        U = U.at[1:-1, 1:-1, 1:-1].set(
+            _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1], **scal))
+        U = U.at[:, :, 0].set(U[:, :, S2 - 2])
+        U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+        return U
+
+    return lax.fori_loop(0, K, step, Text)
+
+
+def test_window_chunk_matches_per_step_on_torus():
+    """(N,M,1) mesh: x and y both extended (corners via the y-neighbor's
+    own x extension); compared against per-step [stencil + update_halo]."""
+    from igg.ops.diffusion_trapezoid import _extend, _mode
+
+    igg.init_global_grid(12, 12, 8, dimx=4, dimy=2, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    assert _mode(grid) == (True, True)
+    K = 4
+    scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
+
+    rng = np.random.default_rng(13)
+    T0 = igg.from_local_blocks(
+        lambda coords, ls: rng.standard_normal(ls) + 10.0 * coords[0]
+        + 100.0 * coords[1], (12, 12, 8))
+    A0 = igg.from_local_blocks(
+        lambda coords, ls: 0.05 + 0.01 * rng.random(ls), (12, 12, 8))
+    T0, A0 = igg.update_halo(T0, A0)
+
+    @igg.sharded
+    def chunk(T, A):
+        A_ext = _extend(A, K, grid, T.shape, True)
+        Text = _extend(T, K, grid, T.shape, True)
+        out = _window_steps_2d(Text, A_ext, K, scal)
+        return out[K:K + T.shape[0], K:K + T.shape[1]]
+
+    @igg.sharded
+    def per_step(T, A):
+        from jax import lax
+
+        def one(_, T):
+            S2 = T.shape[2]
+            T = T.at[1:-1, 1:-1, 1:-1].set(
+                _u_rows(T[:-2], T[1:-1], T[2:], A[1:-1], **scal))
             T = T.at[:, :, 0].set(T[:, :, S2 - 2])
             T = T.at[:, :, S2 - 1].set(T[:, :, 1])
             return igg.update_halo_local(T)
