@@ -121,6 +121,31 @@ def study_hm3d(n, nt, n_inner, platform):
            n, nt, n_inner, platform)
 
 
+def study_wave2d(n, nt, n_inner, platform):
+    """BASELINE config 3: 2-D acoustic wave, 1-D periodic halo, three
+    staggered fields in one grouped exchange (plain step only — the 2-D
+    model has no fused-kernel tier; its step is bandwidth-trivial)."""
+    import igg
+    from igg.models import wave2d
+
+    igg.init_global_grid(n, n, 1, periodx=1, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"wave2d platform={platform} devices={grid.nprocs} "
+         f"dims={grid.dims} local={n}^2")
+    sec = median_of(lambda: wave2d.run(nt, dtype=np.float32,
+                                       n_inner=n_inner)[1])
+    cells = float(n) * n * grid.nprocs   # global cells advanced per step
+    emit({
+        "metric": "wave2d_step_plain",
+        "value": round(sec * 1e3, 4),
+        "unit": "ms",
+        "config": {"local": n, "devices": grid.nprocs,
+                   "dims": list(grid.dims), "platform": platform},
+        "mcells_per_s": round(cells / sec / 1e6, 1),
+    })
+    igg.finalize_global_grid()
+
+
 def main():
     import jax
 
@@ -138,6 +163,9 @@ def main():
     study_stokes(ns, nt, max(n_inner // 2, 2), platform)
     # HM3D (BASELINE config 4's model family) at the diffusion size.
     study_hm3d(n, nt, n_inner, platform)
+    # 2-D wave (BASELINE config 3) at the 2-D local size with the same
+    # cell count as the 3-D grids (n^1.5 squared = n^3).
+    study_wave2d(max(int(n ** 1.5), 16), nt, n_inner, platform)
 
 
 if __name__ == "__main__":

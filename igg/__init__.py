@@ -67,6 +67,7 @@ from .fields import (
 )
 from .overlap import hide_communication
 from .parallel import local_coords, sharded
+from .checkpoint import load_checkpoint, save_checkpoint
 from .timing import time_steps
 from . import device
 from . import profiling
@@ -87,5 +88,6 @@ __all__ = [
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
     "hide_communication", "local_coords", "sharded", "profiling",
+    "save_checkpoint", "load_checkpoint",
     "time_steps", "__version__",
 ]

@@ -1,0 +1,149 @@
+"""Checkpoint / resume for grid fields — a TPU-native extension.
+
+The reference has NO checkpoint facility: `gather!` is its only state
+export, and its examples only visualize the gathered array
+(`/root/reference/src/gather.jl`, SURVEY §5 "Checkpoint / resume: none").
+Long-running pod jobs need one, so this module adds the minimal faithful
+version: save every field's full block-stacked global array (halo cells
+included — on open boundaries they are user-owned data, e.g. physical
+boundary values, and must survive a resume bit-for-bit) plus the grid
+geometry, and restore into an identically-decomposed grid.
+
+Format: one `numpy` `.npz` per checkpoint with a `__igg_meta__` JSON entry
+recording `(nxyz, dims, overlaps, periods, nprocs)`.  Restore validates
+the geometry against the live grid and fails loudly on any mismatch — a
+checkpoint is tied to its decomposition because the stacked array's shape
+is `dims * local` and halo cells are decomposition-dependent.  (To move a
+run to a different decomposition, export the physical field with
+`gather_interior`, re-initialize, and rebuild halos with `update_halo`.)
+
+Multi-controller runs: every process computes the full global array (the
+same `process_allgather` path `gather` uses); only process 0 writes.  On
+restore every process reads the file (shared filesystem, the standard pod
+setup) and `device_put`s its own shards.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict
+
+import numpy as np
+
+from . import shared
+from .shared import GridError
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_META_KEY = "__igg_meta__"
+
+
+def _meta(grid) -> dict:
+    return {
+        "nxyz": list(grid.nxyz),
+        "dims": list(grid.dims),
+        "overlaps": list(grid.overlaps),
+        "periods": list(grid.periods),
+        "nprocs": grid.nprocs,
+    }
+
+
+def _write_npz(path, arrays: Dict[str, np.ndarray]) -> None:
+    """`np.savez` without its two footguns: the `file=` keyword collides
+    with a field named "file", and a missing `.npz` suffix makes savez
+    write to a DIFFERENT path than given (breaking the load round-trip).
+    This writes the same uncompressed npy-zip format np.load reads, to the
+    exact path given."""
+    import io
+    import os
+    import zipfile
+
+    # Atomic: a crash mid-write must not destroy the previous checkpoint at
+    # `path` (the overwrite-in-place pattern is the module's whole purpose).
+    tmp = path.with_name(path.name + ".tmp")
+    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED) as zf:
+        for name, arr in arrays.items():
+            buf = io.BytesIO()
+            np.lib.format.write_array(buf, arr, allow_pickle=False)
+            zf.writestr(name + ".npy", buf.getvalue())
+    os.replace(tmp, path)
+
+
+def save_checkpoint(path, /, **fields) -> None:
+    """Write the named grid fields and the grid geometry to `path` (.npz).
+
+    Fields are full block-stacked global arrays (any stagger, any dtype);
+    every process participates (multi-controller shards are exchanged over
+    the runtime), process 0 writes.
+    """
+    import jax
+
+    from .gather import _fetch_global
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    if not fields:
+        raise GridError("save_checkpoint: no fields given.")
+
+    host: Dict[str, np.ndarray] = {}
+    dtypes: Dict[str, str] = {}
+    for name, A in fields.items():
+        if name == _META_KEY:
+            raise GridError(f"save_checkpoint: field name {_META_KEY!r} is "
+                            f"reserved.")
+        arr = np.ascontiguousarray(_fetch_global(A))
+        dtypes[name] = str(arr.dtype)
+        if arr.dtype.kind == "V" or arr.dtype.str.startswith("|V"):
+            # Extension dtypes (bfloat16, float8_*) have no portable npy
+            # descr; store the raw bytes and the true dtype name in meta.
+            arr = arr.view(np.uint8)
+        host[name] = arr
+
+    if jax.process_index() == 0:
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        meta = {**_meta(grid), "dtypes": dtypes}
+        _write_npz(path, {**host, _META_KEY: np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)})
+    if jax.process_count() > 1:
+        # Multi-controller: no process may return (and possibly reload the
+        # file) before process 0 finished writing it.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("igg_save_checkpoint")
+
+
+def load_checkpoint(path, /) -> Dict:
+    """Read a checkpoint written by :func:`save_checkpoint` and return
+    `{name: sharded jax.Array}` on the CURRENT grid, which must have the
+    geometry the checkpoint was written under (validated; `GridError` on
+    mismatch)."""
+    import jax
+
+    from .fields import sharding_for
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    with np.load(pathlib.Path(path)) as z:
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+
+    mine = _meta(grid)
+    if {k: meta.get(k) for k in mine} != mine:
+        diffs = {k: (meta.get(k), mine[k]) for k in mine
+                 if meta.get(k) != mine[k]}
+        raise GridError(
+            f"load_checkpoint: grid geometry mismatch {diffs} "
+            f"(checkpoint vs current).  A checkpoint restores only onto an "
+            f"identical decomposition; to re-decompose, export with "
+            f"gather_interior and re-initialize instead.")
+
+    dtypes = meta.get("dtypes", {})
+    out = {}
+    for name, arr in arrays.items():
+        want = np.dtype(dtypes.get(name, str(arr.dtype)))
+        if arr.dtype != want:
+            arr = arr.view(want)   # extension dtypes stored as raw bytes
+        out[name] = jax.device_put(arr, sharding_for(arr.ndim))
+    return out
