@@ -527,7 +527,7 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
     rather than slices of the block."""
     import jax.numpy as jnp
 
-    from .ops.halo_write import halo_write, halo_write_slabs
+    from .ops.halo_write import halo_write_slabs, write_lane_active
 
     _check_assembly(assembly)
     if assembly == "xla" or not (_is_tpu(grid) or _FORCE_WRITER_INTERPRET):
@@ -539,7 +539,7 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
               jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
     interp = _FORCE_WRITER_INTERPRET
     if any(d == out.ndim - 1 for d, _ in dims_active):
-        return halo_write(out, specs, interpret=interp)
+        return write_lane_active(out, specs, frozenset(), interpret=interp)
     return halo_write_slabs(out, specs, interpret=interp)
 
 
@@ -581,7 +581,7 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
     import jax.numpy as jnp
 
     from .ops.pack import pack_planes_supported, pack_planes
-    from .ops.halo_write import halo_write, halo_write_slabs
+    from .ops.halo_write import halo_write_slabs, write_lane_active
 
     _check_assembly(assembly)
     on_tpu = _is_tpu(grid)
@@ -648,7 +648,8 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
                 specs.append((d, "ext", jnp.squeeze(first, d),
                               jnp.squeeze(last, d)))
         interp = _FORCE_WRITER_INTERPRET
-        out.append(halo_write(A, specs, interpret=interp) if lane_active
+        out.append(write_lane_active(A, specs, wraps[i], interpret=interp)
+                   if lane_active
                    else halo_write_slabs(A, specs, interpret=interp))
     return tuple(out)
 
