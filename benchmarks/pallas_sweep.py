@@ -89,7 +89,7 @@ def main():
         for bx, y_ext in ((8, False), (16, False), (8, True)):
             T, Cp = fresh()
             A = float(dt * params.lam) / Cp
-            if not trapezoid_supported(grid, T.shape, bx, n_inner, False,
+            if not trapezoid_supported(grid, T.shape, bx, n_inner,
                                        T.dtype, force_y_ext=y_ext):
                 note(f"trapezoid bx={bx}: unsupported at {n}^3")
                 continue

@@ -469,13 +469,13 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
     # through to the per-step loop below.
     from .diffusion_trapezoid import (fused_diffusion_trapezoid_steps,
                                       trapezoid_supported)
-    if trapezoid_supported(grid, T.shape, bx, n_inner - 1, interpret,
-                           T.dtype):
+    if trapezoid_supported(grid, T.shape, bx, n_inner - 1, T.dtype):
         T = fused_diffusion_step(T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
                                  lam=lam, bx=bx, interpret=interpret)
         n_inner -= 1
         T, done = fused_diffusion_trapezoid_steps(
-            T, A, n_inner=n_inner, bx=bx, grid=grid, **scal)
+            T, A, n_inner=n_inner, bx=bx, grid=grid, interpret=interpret,
+            **scal)
         n_inner -= done
         if n_inner == 0:
             return T

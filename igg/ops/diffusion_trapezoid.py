@@ -47,8 +47,10 @@ shape differences that SPMD programs cannot express.  The dispatcher in
 chunks, which consumes never-exchanged entry halos exactly like every
 other path (bit-equivalence for ANY input).
 
-Not available in interpret mode (manual TPU DMA/semaphores), like the
-mega-kernel; callers fall back to the per-step kernel.
+In interpret mode (CPU meshes, the driver dryrun) the chunk runs as a
+pure-XLA realization of the same window dynamics (`_window_steps_xla`) —
+the chunked exchange, corner-carrying extensions, and shrinking validity
+are exercised everywhere; only the manual-DMA kernel itself is TPU-only.
 """
 
 from __future__ import annotations
@@ -69,16 +71,17 @@ def _mode(grid):
     return True, grid.dims[1] > 1
 
 
-def trapezoid_supported(grid, shape, bx: int, n_inner: int,
-                        interpret: bool, dtype,
+def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
                         force_y_ext=None) -> bool:
-    """Whether the K=bx trapezoidal chunk kernel applies: compiled mode,
-    fully-periodic x ring (and y ring when y is split), z self-wrap, at
-    least one full chunk, the K-slab sends must lie inside the block, and
-    the extended coefficient plus working buffers must fit in VMEM."""
+    """Whether the K=bx trapezoidal chunk path applies: fully-periodic
+    x ring (and y ring when y is split), z self-wrap, at least one full
+    chunk, the K-slab sends must lie inside the block, and the extended
+    coefficient plus working buffers must fit in VMEM (the interpret-mode
+    XLA fallback obeys the same gates so both modes take the same
+    route)."""
     import numpy as np
 
-    if interpret or n_inner < bx or bx < 2:
+    if n_inner < bx or bx < 2:
         return False
     ok, y_ext = _mode(grid)
     if not ok:
@@ -250,7 +253,31 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
         pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
 
 
-def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2):
+def _window_steps_xla(Text, A_ext, *, K, y_ext, rdx2, rdy2, rdz2):
+    """Pure-XLA realization of the chunk kernel's per-step update (interior
+    x rows; y wrap or extended; z self-wrap) — the interpret-mode fallback
+    so CPU meshes and the driver dryrun exercise the SAME chunked-exchange
+    /shrinking-validity structure the TPU kernel runs (the kernel itself is
+    manual-DMA and has no interpret mode)."""
+    from jax import lax
+
+    def step(_, U):
+        S1e, S2 = U.shape[1], U.shape[2]
+        U = U.at[1:-1, 1:-1, 1:-1].set(
+            _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1],
+                    rdx2=rdx2, rdy2=rdy2, rdz2=rdz2))
+        if not y_ext:
+            U = U.at[:, 0, 1:-1].set(U[:, S1e - 2, 1:-1])
+            U = U.at[:, S1e - 1, 1:-1].set(U[:, 1, 1:-1])
+        U = U.at[:, :, 0].set(U[:, :, S2 - 2])
+        U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+        return U
+
+    return lax.fori_loop(0, K, step, Text)
+
+
+def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2,
+                interpret=False):
     """Advance K steps on the extended buffer; returns the central
     `out_shape3` window."""
     import jax
@@ -260,6 +287,11 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2):
 
     S0e, S1e, S2 = Text.shape
     S0, S1o, _ = out_shape3
+    if interpret:
+        out = _window_steps_xla(Text, A_ext, K=K, y_ext=y_ext,
+                                rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+        out = lax.slice_in_dim(out, K, K + S0, axis=0)
+        return lax.slice_in_dim(out, K, K + S1o, axis=1) if y_ext else out
     assert K == bx, "chunk depth is pinned to the block row count"
     nbe = S0e // bx
     nbo = S0 // bx
@@ -348,7 +380,7 @@ def _extend(T, K, grid, shape, y_ext):
 
 def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
                                     grid, rdx2, rdy2, rdz2,
-                                    force_y_ext=None):
+                                    force_y_ext=None, interpret=False):
     """Advance `n_inner` steps in chunks of K=bx trapezoidal kernel calls
     (plus a per-step remainder handled by the caller; this function runs
     only the `n_inner // bx` full chunks and returns `(T, steps_done)`).
@@ -367,7 +399,8 @@ def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
     def one(_, T):
         Text = _extend(T, K, grid, shape, y_ext)
         return _chunk_call(Text, A_ext, shape, K=K, bx=bx, y_ext=y_ext,
-                           rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
+                           rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
+                           interpret=interpret)
 
     T = lax.fori_loop(0, chunks, one, T)
     return T, chunks * K

@@ -83,7 +83,7 @@ def test_trapezoid_matches_per_step_kernel():
                 rdz2=1.0 / (dz * dz))
     A = float(params.timestep() * params.lam) / Cp
     bx = 8
-    assert trapezoid_supported(grid, T.shape, bx, 2 * bx, False, T.dtype)
+    assert trapezoid_supported(grid, T.shape, bx, 2 * bx, T.dtype)
 
     out, done = jax.jit(
         lambda T, A: fused_diffusion_trapezoid_steps(

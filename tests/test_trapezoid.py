@@ -142,3 +142,34 @@ def test_window_chunk_matches_per_step_on_torus():
     out = np.asarray(chunk(T0, A0))
     ref = np.asarray(per_step(T0, A0))
     np.testing.assert_allclose(out, ref, rtol=0, atol=1e-12)
+
+
+def test_model_path_interpret_ring():
+    """fused_diffusion_steps routes an (8,1,1) periodic CPU mesh through
+    the trapezoid chunking (XLA window fallback in interpret mode) and must
+    match the plain XLA multi-step path."""
+    import numpy as np
+
+    import igg
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_trapezoid import trapezoid_supported
+
+    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params(lx=8.0, ly=8.0, lz=60.0)
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    n_inner = 9  # warm-up step + one K=8 chunk
+    assert trapezoid_supported(grid, (16, 16, 128), 8, n_inner - 1,
+                               np.float32)
+
+    ref_step = d3.make_multi_step(n_inner, params, use_pallas=False,
+                                  donate=False)
+    # bx=8 so the chunk gate (n_inner-1 >= K=bx) holds: one 8-step chunk
+    # through _window_steps_xla + the warm-up per-step.
+    pal_step = d3.make_multi_step(n_inner, params, use_pallas=True,
+                                  pallas_interpret=True, donate=False, bx=8)
+    ref = np.asarray(ref_step(T, Cp), np.float64)
+    out = np.asarray(pal_step(T, Cp), np.float64)
+    scale = max(abs(ref).max(), 1e-30)
+    assert abs(out - ref).max() <= 4e-6 * scale
