@@ -117,7 +117,12 @@ def study_hm3d(n, nt, n_inner, platform):
     from igg.models import hm3d
     from igg.ops import hm3d_pallas_supported
 
-    _study(hm3d.run, "hm3d_step", hm3d_pallas_supported, {}, {},
+    # hm3d.run defaults use_pallas="auto"; the plain/hidden variants must
+    # pin the XLA path explicitly (same as study_diffusion).
+    def run(nt, *, use_pallas=False, **kw):
+        return hm3d.run(nt, use_pallas=use_pallas, **kw)
+
+    _study(run, "hm3d_step", hm3d_pallas_supported, {}, {},
            n, nt, n_inner, platform)
 
 

@@ -102,23 +102,18 @@ def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
     restructures with the multi-field :func:`igg.hide_communication`
     (BASELINE config 4's weak-scaling workload).  `use_pallas=True` runs
     the whole step (compute + grouped halo update) as ONE fused kernel
-    (`igg.ops.fused_hm3d_step`; self-wrap grids only)."""
+    (`igg.ops.fused_hm3d_step`, any mesh); it raises `GridError` when the
+    kernel is inapplicable (the auto-fallback lives in :func:`make_step`)."""
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
     if use_pallas:
-        import jax.numpy as jnp
+        from igg.ops import fused_hm3d_step
 
-        from igg.ops import fused_hm3d_step, hm3d_pallas_supported
-
-        grid = igg.get_global_grid()
-        platform_ok = (pallas_interpret or
-                       next(iter(grid.mesh.devices.flat)).platform == "tpu")
-        if (overlap or not platform_ok or Pe.dtype != jnp.float32
-                or not hm3d_pallas_supported(grid, Pe)):
+        if overlap:
             raise igg.GridError(
-                "the fused HM3D step requires TPU devices (or "
-                "pallas_interpret=True), a fully-periodic single-device "
-                "overlap-2 grid, f32 fields, x divisible by 4, and "
-                "overlap=False; use the XLA path otherwise.")
+                "the fused HM3D step has overlap (hide_communication) "
+                "semantics built in; drop overlap=True when passing "
+                "use_pallas.")
+        _pallas_applicable(True, Pe, interpret=pallas_interpret)  # or raises
         return fused_hm3d_step(Pe, phi, **kw, interpret=pallas_interpret)
     if overlap:
         return igg.hide_communication(
@@ -126,32 +121,87 @@ def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
     return igg.update_halo_local(*compute_step(Pe, phi, **kw))
 
 
+_PALLAS_REQ = (
+    "the fused HM3D step requires TPU devices (or pallas_interpret=True), "
+    "an overlap-2 grid, and f32 unstaggered fields with local shape "
+    "divisible into x-slabs (x % 4 == 0, y >= 8, z >= 8; z >= 128 when z "
+    "is exchanged); use the XLA path otherwise.")
+
+
+def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
+    import jax.numpy as jnp
+
+    from igg.ops import hm3d_pallas_supported
+
+    if use_pallas is False:
+        return False
+    grid = igg.get_global_grid()
+    platform_ok = (interpret
+                   or next(iter(grid.mesh.devices.flat)).platform == "tpu")
+    ok = (platform_ok and Pe.dtype == jnp.float32
+          and hm3d_pallas_supported(grid, Pe))
+    if use_pallas is True and not ok:
+        raise igg.GridError(_PALLAS_REQ)
+    return ok
+
+
 def make_step(params: Params = Params(), *, donate: bool = True,
               overlap: bool = False, n_inner: int = 1,
-              use_pallas: bool = False, pallas_interpret: bool = False):
+              use_pallas="auto", pallas_interpret: bool = False):
+    """Compiled `(Pe, phi) -> (Pe, phi)` advancing `n_inner` steps in one
+    SPMD program.  `use_pallas`: "auto" (default) uses the fused kernel
+    (`igg.ops.fused_hm3d_steps`, with boundary-slab carry) when it applies —
+    TPU devices, overlap-2 grid, f32 fields, any device count/periodicity;
+    False forces the portable shard_map/XLA path; True requires the kernel
+    and raises if inapplicable.  `overlap` restructures the XLA path with
+    `igg.hide_communication`; the fused kernel has overlap semantics built
+    in (its exchange is always data-independent of the main kernel), so it
+    satisfies both settings — exactly like diffusion3d."""
     from jax import lax
 
     dx, dy, dz = params.spacing()
     dt = params.timestep()
     phi0, npow, eta = params.phi0, params.npow, params.eta
+    # NOTE: the step closures capture only hashable scalars so recreated
+    # closures share one compiled program (`igg.parallel._fn_key`).
 
-    def step(Pe, phi):
+    def xla_steps(Pe, phi):
         return lax.fori_loop(
             0, n_inner,
             lambda _, S: local_step(*S, dx=dx, dy=dy, dz=dz, dt=dt,
                                     phi0=phi0, npow=npow, eta=eta,
-                                    overlap=overlap, use_pallas=use_pallas,
-                                    pallas_interpret=pallas_interpret),
+                                    overlap=overlap),
             (Pe, phi))
 
-    # check_vma: interpret-mode pallas_call does not propagate shard_map's
-    # varying-manual-axes metadata (same workaround as stokes3d/diffusion3d).
-    return igg.sharded(step, donate_argnums=(0, 1) if donate else (),
-                       check_vma=not (use_pallas and pallas_interpret))
+    xla_path = igg.sharded(xla_steps, donate_argnums=(0, 1) if donate else ())
+    pallas_path = None
+
+    def dispatch(Pe, phi):
+        nonlocal pallas_path
+        if _pallas_applicable(use_pallas, Pe, interpret=pallas_interpret):
+            if pallas_path is None:
+                from igg.ops import fused_hm3d_steps
+
+                def pallas_steps(Pe, phi):
+                    return fused_hm3d_steps(
+                        Pe, phi, n_inner=n_inner, dx=dx, dy=dy, dz=dz,
+                        dt=dt, phi0=phi0, npow=npow, eta=eta,
+                        interpret=pallas_interpret)
+
+                # check_vma: interpret-mode pallas_call does not propagate
+                # shard_map's varying-manual-axes metadata (same workaround
+                # as stokes3d/diffusion3d).
+                pallas_path = igg.sharded(
+                    pallas_steps, donate_argnums=(0, 1) if donate else (),
+                    check_vma=not pallas_interpret)
+            return pallas_path(Pe, phi)
+        return xla_path(Pe, phi)
+
+    return dispatch
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1, use_pallas: bool = False):
+        overlap: bool = False, n_inner: int = 1, use_pallas="auto"):
     """Slope-timed run (see :func:`igg.time_steps`)."""
     Pe, phi = init_fields(params, dtype=dtype)
     step = make_step(params, overlap=overlap, n_inner=n_inner,

@@ -13,10 +13,11 @@ from .diffusion_pallas import (
     pallas_supported,
 )
 from .stencil import interior_add
-from .hm3d_pallas import fused_hm3d_step, hm3d_pallas_supported
+from .hm3d_pallas import (fused_hm3d_step, fused_hm3d_steps,
+                          hm3d_pallas_supported)
 from .stokes_pallas import fused_stokes_iteration, stokes_pallas_supported
 
 __all__ = ["diffusion_compute", "fused_diffusion_step",
-           "fused_diffusion_steps", "fused_hm3d_step",
+           "fused_diffusion_steps", "fused_hm3d_step", "fused_hm3d_steps",
            "fused_stokes_iteration", "hm3d_pallas_supported",
            "interior_add", "pallas_supported", "stokes_pallas_supported"]
