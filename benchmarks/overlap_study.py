@@ -108,7 +108,12 @@ def study_stokes(n, nt, n_inner, platform):
 
     # Radius-2 update chain: overlap-3 grid (reference supports overlap>=3,
     # `/root/reference/test/test_update_halo.jl:188-217`).
-    _study(stokes3d.run, "stokes3d_iteration", stokes_pallas_supported,
+    # stokes3d.run defaults use_pallas="auto"; the plain/hidden variants
+    # must pin the XLA path explicitly (same as study_diffusion).
+    def run(nt, *, use_pallas=False, **kw):
+        return stokes3d.run(nt, use_pallas=use_pallas, **kw)
+
+    _study(run, "stokes3d_iteration", stokes_pallas_supported,
            dict(overlapx=3, overlapy=3, overlapz=3),
            {"overlap_cells": 3}, n, nt, n_inner, platform)
 

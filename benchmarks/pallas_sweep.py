@@ -86,21 +86,23 @@ def main():
                 "platform": platform,
             })
 
-        for bx, y_ext in ((8, False), (16, False), (8, True)):
+        for bx, y_ext, z_ext in ((8, False, False), (16, False, False),
+                                 (8, True, False), (8, True, True)):
             T, Cp = fresh()
             A = float(dt * params.lam) / Cp
             if not trapezoid_supported(grid, T.shape, bx, n_inner,
-                                       T.dtype, force_y_ext=y_ext):
+                                       T.dtype, force_y_ext=y_ext,
+                                       force_z_ext=z_ext):
                 note(f"trapezoid bx={bx}: unsupported at {n}^3")
                 continue
             steps = (n_inner // bx) * bx
             fn = jax.jit(
-                lambda T, bx=bx, A=A, s=steps, ye=y_ext:
+                lambda T, bx=bx, A=A, s=steps, ye=y_ext, ze=z_ext:
                 fused_diffusion_trapezoid_steps(
                     T, A, n_inner=s, bx=bx, grid=grid, force_y_ext=ye,
-                    **scal)[0],
+                    force_z_ext=ze, **scal)[0],
                 donate_argnums=0)
-            tag = "torus" if y_ext else "ring"
+            tag = "torus3d" if z_ext else ("torus" if y_ext else "ring")
             measure(f"trapezoid_{tag}_bx{bx}", fn, T, steps)
 
         T, Cp = fresh()

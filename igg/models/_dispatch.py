@@ -1,0 +1,60 @@
+"""Shared `use_pallas` dispatch scaffolding for the model families.
+
+Each model family exposes the same three-valued contract on its compiled
+step factory: `use_pallas="auto"` (default) routes to the fused Pallas
+kernel when it applies and silently falls back to the portable
+shard_map/XLA path otherwise; `False` forces the XLA path; `True` requires
+the kernel and raises `GridError` with the family's requirement string.
+This module is the single implementation of that contract (applicability
+probe + lazily-built sharded pallas path), parameterized by the family's
+`supported(grid, field)` gate, requirement message, and fused-step
+builder."""
+
+from __future__ import annotations
+
+import igg
+
+
+def pallas_applicable(use_pallas, field, *, supported_fn, requirement,
+                      interpret: bool = False) -> bool:
+    """The auto/True/False applicability probe: TPU devices (or interpret
+    mode), f32 fields, and the family's `supported_fn` gate.  Raises
+    `GridError(requirement)` when `use_pallas is True` but the kernel is
+    inapplicable."""
+    import jax.numpy as jnp
+
+    if use_pallas is False:
+        return False
+    grid = igg.get_global_grid()
+    platform_ok = (interpret
+                   or next(iter(grid.mesh.devices.flat)).platform == "tpu")
+    ok = (platform_ok and field.dtype == jnp.float32
+          and supported_fn(grid, field))
+    if use_pallas is True and not ok:
+        raise igg.GridError(requirement)
+    return ok
+
+
+def auto_dispatch(*, use_pallas, interpret, supported_fn, requirement,
+                  xla_path, build_pallas_steps, donate_argnums):
+    """The compiled-entry dispatcher shared by the model factories:
+    per-call applicability probe on the first field argument, lazily
+    compiling the fused path through `igg.sharded` on first use.
+
+    `build_pallas_steps()` returns the local (per-device) fused step
+    function; `check_vma=not interpret` works around interpret-mode
+    pallas_call not propagating shard_map's varying-manual-axes metadata."""
+    pallas_path = None
+
+    def dispatch(*args):
+        nonlocal pallas_path
+        if pallas_applicable(use_pallas, args[0], supported_fn=supported_fn,
+                             requirement=requirement, interpret=interpret):
+            if pallas_path is None:
+                pallas_path = igg.sharded(
+                    build_pallas_steps(), donate_argnums=donate_argnums,
+                    check_vma=not interpret)
+            return pallas_path(*args)
+        return xla_path(*args)
+
+    return dispatch

@@ -109,23 +109,19 @@ def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False):
     return igg.update_halo_local(compute_step(T, Cp, **kw), assembly="xla")
 
 
-def _pallas_applicable(use_pallas, T, interpret: bool = False) -> bool:
-    import jax.numpy as jnp
+_PALLAS_REQ = (
+    "the fused Pallas step requires TPU devices (or interpret=True), "
+    "an overlap-2 grid, and an f32 unstaggered field with local "
+    "shape divisible into x-slabs (x % 4 == 0, y >= 8, z >= 128).")
 
+
+def _pallas_applicable(use_pallas, T, interpret: bool = False) -> bool:
     from igg.ops import pallas_supported
-    if use_pallas is False:
-        return False
-    grid = igg.get_global_grid()
-    platform_ok = (interpret
-                   or next(iter(grid.mesh.devices.flat)).platform == "tpu")
-    ok = (pallas_supported(grid, T) and T.dtype == jnp.float32
-          and platform_ok)
-    if use_pallas is True and not ok:
-        raise igg.GridError(
-            "the fused Pallas step requires TPU devices (or interpret=True), "
-            "an overlap-2 grid, and an f32 unstaggered field with local "
-            "shape divisible into x-slabs (x % 4 == 0, y >= 8, z >= 128).")
-    return ok
+
+    from ._dispatch import pallas_applicable
+
+    return pallas_applicable(use_pallas, T, supported_fn=pallas_supported,
+                             requirement=_PALLAS_REQ, interpret=interpret)
 
 
 def _best_bx(S0: int) -> int:
@@ -200,30 +196,27 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
         return lax.fori_loop(0, n_inner, lambda _, T: one(T), T)
 
     xla_path = igg.sharded(xla_steps, donate_argnums=(0,) if donate else ())
-    pallas_path = None
 
-    def dispatch(T, Cp):
-        nonlocal pallas_path
-        if _pallas_applicable(use_pallas, T, interpret=pallas_interpret):
-            if pallas_path is None:
-                from igg.ops import fused_diffusion_steps
-                bx_ = bx or _best_bx(igg.get_global_grid().nxyz[0])
+    def build_pallas_steps():
+        from igg.ops import fused_diffusion_steps
+        bx_ = bx or _best_bx(igg.get_global_grid().nxyz[0])
 
-                def pallas_steps(T, Cp):
-                    return fused_diffusion_steps(
-                        T, Cp, n_inner=n_inner, dx=dx, dy=dy, dz=dz, dt=dt,
-                        lam=lam, bx=bx_, interpret=pallas_interpret)
+        def pallas_steps(T, Cp):
+            return fused_diffusion_steps(
+                T, Cp, n_inner=n_inner, dx=dx, dy=dy, dz=dz, dt=dt,
+                lam=lam, bx=bx_, interpret=pallas_interpret)
 
-                # Interpret mode evaluates the kernel body as jax ops inside
-                # shard_map, where the vma checker rejects scalar-vs-block
-                # mixes that the real Mosaic lowering handles fine.
-                pallas_path = igg.sharded(
-                    pallas_steps, donate_argnums=(0,) if donate else (),
-                    check_vma=not pallas_interpret)
-            return pallas_path(T, Cp)
-        return xla_path(T, Cp)
+        return pallas_steps
 
-    return dispatch
+    from igg.ops import pallas_supported
+
+    from ._dispatch import auto_dispatch
+
+    return auto_dispatch(
+        use_pallas=use_pallas, interpret=pallas_interpret,
+        supported_fn=pallas_supported, requirement=_PALLAS_REQ,
+        xla_path=xla_path, build_pallas_steps=build_pallas_steps,
+        donate_argnums=(0,) if donate else ())
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,

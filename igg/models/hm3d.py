@@ -129,20 +129,13 @@ _PALLAS_REQ = (
 
 
 def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
-    import jax.numpy as jnp
-
     from igg.ops import hm3d_pallas_supported
 
-    if use_pallas is False:
-        return False
-    grid = igg.get_global_grid()
-    platform_ok = (interpret
-                   or next(iter(grid.mesh.devices.flat)).platform == "tpu")
-    ok = (platform_ok and Pe.dtype == jnp.float32
-          and hm3d_pallas_supported(grid, Pe))
-    if use_pallas is True and not ok:
-        raise igg.GridError(_PALLAS_REQ)
-    return ok
+    from ._dispatch import pallas_applicable
+
+    return pallas_applicable(use_pallas, Pe,
+                             supported_fn=hm3d_pallas_supported,
+                             requirement=_PALLAS_REQ, interpret=interpret)
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
@@ -174,30 +167,26 @@ def make_step(params: Params = Params(), *, donate: bool = True,
             (Pe, phi))
 
     xla_path = igg.sharded(xla_steps, donate_argnums=(0, 1) if donate else ())
-    pallas_path = None
 
-    def dispatch(Pe, phi):
-        nonlocal pallas_path
-        if _pallas_applicable(use_pallas, Pe, interpret=pallas_interpret):
-            if pallas_path is None:
-                from igg.ops import fused_hm3d_steps
+    def build_pallas_steps():
+        from igg.ops import fused_hm3d_steps
 
-                def pallas_steps(Pe, phi):
-                    return fused_hm3d_steps(
-                        Pe, phi, n_inner=n_inner, dx=dx, dy=dy, dz=dz,
-                        dt=dt, phi0=phi0, npow=npow, eta=eta,
-                        interpret=pallas_interpret)
+        def pallas_steps(Pe, phi):
+            return fused_hm3d_steps(
+                Pe, phi, n_inner=n_inner, dx=dx, dy=dy, dz=dz, dt=dt,
+                phi0=phi0, npow=npow, eta=eta, interpret=pallas_interpret)
 
-                # check_vma: interpret-mode pallas_call does not propagate
-                # shard_map's varying-manual-axes metadata (same workaround
-                # as stokes3d/diffusion3d).
-                pallas_path = igg.sharded(
-                    pallas_steps, donate_argnums=(0, 1) if donate else (),
-                    check_vma=not pallas_interpret)
-            return pallas_path(Pe, phi)
-        return xla_path(Pe, phi)
+        return pallas_steps
 
-    return dispatch
+    from igg.ops import hm3d_pallas_supported
+
+    from ._dispatch import auto_dispatch
+
+    return auto_dispatch(
+        use_pallas=use_pallas, interpret=pallas_interpret,
+        supported_fn=hm3d_pallas_supported, requirement=_PALLAS_REQ,
+        xla_path=xla_path, build_pallas_steps=build_pallas_steps,
+        donate_argnums=(0, 1) if donate else ())
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,

@@ -56,13 +56,20 @@ def init_fields(params: Params = Params(), dtype=np.float32):
     return P, Vx, Vy, Vz, Rho
 
 
-def iteration_core(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
+def iteration_core(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
+                   buoy_axis: int = 2):
     """The raw coupled arithmetic shared VERBATIM by the XLA path and the
     fused Pallas kernel (`igg.ops.stokes_pallas`) — one source of truth, so
     the two paths agree to Mosaic-vs-XLA rounding (~1 ulp).  Returns the
     full-shape updated pressure and the *interior* velocity increments
     `(P', rx, ry, rz)`; callers apply the increments with
-    :func:`igg.ops.interior_add` (XLA) or interior ref writes (kernel)."""
+    :func:`igg.ops.interior_add` (XLA) or interior ref writes (kernel).
+
+    `buoy_axis` names the axis whose velocity the buoyancy term drives
+    (physical z by default).  The arithmetic is otherwise symmetric under a
+    y<->z swap of axes, fields, and spacings, which the fused kernel's
+    transposed z-window send-plane computation exploits with
+    `buoy_axis=1`."""
     # Divergence at cell centers
     divV = ((Vx[1:, :, :] - Vx[:-1, :, :]) / dx
             + (Vy[:, 1:, :] - Vy[:, :-1, :]) / dy
@@ -92,16 +99,19 @@ def iteration_core(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
           + (txy[1:, :, 1:-1] - txy[:-1, :, 1:-1]) / dx
           + (tyz[1:-1, :, 1:] - tyz[1:-1, :, :-1]) / dz
           - (P[1:-1, 1:, 1:-1] - P[1:-1, :-1, 1:-1]) / dy)
-    rho_face = 0.5 * (Rho[1:-1, 1:-1, 1:] + Rho[1:-1, 1:-1, :-1])
     rz = ((tzz[1:-1, 1:-1, 1:] - tzz[1:-1, 1:-1, :-1]) / dz
           + (txz[1:, 1:-1, :] - txz[:-1, 1:-1, :]) / dx
           + (tyz[1:-1, 1:, :] - tyz[1:-1, :-1, :]) / dy
-          - (P[1:-1, 1:-1, 1:] - P[1:-1, 1:-1, :-1]) / dz
-          + rho_face)                                    # buoyancy drives Vz
+          - (P[1:-1, 1:-1, 1:] - P[1:-1, 1:-1, :-1]) / dz)
+    if buoy_axis == 2:                                   # buoyancy drives Vz
+        rz = rz + 0.5 * (Rho[1:-1, 1:-1, 1:] + Rho[1:-1, 1:-1, :-1])
+    else:                  # transposed windows: physical z sits on axis 1
+        ry = ry + 0.5 * (Rho[1:-1, 1:, 1:-1] + Rho[1:-1, :-1, 1:-1])
     return P, dtV * rx, dtV * ry, dtV * rz
 
 
-def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
+def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
+                      buoy_axis: int = 2):
     """The pure coupled update (no halo exchange): pressure then velocities,
     interior cells only — shift-invariant, so it applies both full-domain
     and to the boundary slabs of :func:`igg.hide_communication`.  Effective
@@ -110,7 +120,8 @@ def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV):
     from igg.ops import interior_add
 
     P, dVx, dVy, dVz = iteration_core(P, Vx, Vy, Vz, Rho, dx=dx, dy=dy,
-                                      dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+                                      dz=dz, mu=mu, dtP=dtP, dtV=dtV,
+                                      buoy_axis=buoy_axis)
     Vx = interior_add(Vx, dVx)
     Vy = interior_add(Vy, dVy)
     Vz = interior_add(Vz, dVz)
@@ -131,23 +142,19 @@ def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
     overlap >= 3 (BASELINE config 5: "Stokes solver with comm/compute
     overlap").  With `use_pallas=True` the whole iteration (compute + the
     grouped halo update) runs as ONE fused kernel
-    (`igg.ops.fused_stokes_iteration`; self-wrap grids only)."""
+    (`igg.ops.fused_stokes_iteration`, any mesh); it raises `GridError`
+    when the kernel is inapplicable (the auto-fallback lives in
+    :func:`make_iteration`)."""
     kw = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
     if use_pallas:
-        import jax.numpy as jnp
+        from igg.ops import fused_stokes_iteration
 
-        from igg.ops import fused_stokes_iteration, stokes_pallas_supported
-
-        grid = igg.get_global_grid()
-        platform_ok = (pallas_interpret or
-                       next(iter(grid.mesh.devices.flat)).platform == "tpu")
-        if (overlap or not platform_ok or P.dtype != jnp.float32
-                or not stokes_pallas_supported(grid, P)):
+        if overlap:
             raise igg.GridError(
-                "the fused Stokes iteration requires TPU devices (or "
-                "pallas_interpret=True), a fully-periodic single-device "
-                "overlap-3 grid, f32 fields, x divisible by 8, and "
-                "overlap=False; use the XLA path otherwise.")
+                "the fused Stokes iteration has overlap "
+                "(hide_communication) semantics built in; drop "
+                "overlap=True when passing use_pallas.")
+        _pallas_applicable(True, P, interpret=pallas_interpret)  # or raises
         return fused_stokes_iteration(P, Vx, Vy, Vz, Rho, **kw,
                                       interpret=pallas_interpret)
     if overlap:
@@ -160,6 +167,23 @@ def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
     return igg.update_halo_local(P, Vx, Vy, Vz)
 
 
+_PALLAS_REQ = (
+    "the fused Stokes iteration requires TPU devices (or "
+    "pallas_interpret=True), an overlap-3 grid, and f32 fields with local "
+    "shape divisible into x-slabs (x % 8 == 0, x >= 16, y >= 8, z >= 8); "
+    "use the XLA path otherwise.")
+
+
+def _pallas_applicable(use_pallas, P, interpret: bool = False) -> bool:
+    from igg.ops import stokes_pallas_supported
+
+    from ._dispatch import pallas_applicable
+
+    return pallas_applicable(use_pallas, P,
+                             supported_fn=stokes_pallas_supported,
+                             requirement=_PALLAS_REQ, interpret=interpret)
+
+
 def _pseudo_steps(params: Params):
     dx, dy, dz = params.spacing()
     n_min = min(igg.nx_g(), igg.ny_g(), igg.nz_g())
@@ -170,33 +194,60 @@ def _pseudo_steps(params: Params):
 
 def make_iteration(params: Params = Params(), *, donate: bool = True,
                    overlap: bool = False, n_inner: int = 1,
-                   use_pallas: bool = False, pallas_interpret: bool = False):
+                   use_pallas="auto", pallas_interpret: bool = False):
     """Compiled `(P, Vx, Vy, Vz, Rho) -> (P, Vx, Vy, Vz)` advancing
-    `n_inner` iterations in one SPMD program."""
+    `n_inner` iterations in one SPMD program.  `use_pallas`: "auto"
+    (default) uses the fused kernel when it applies — TPU devices,
+    overlap-3 grid, f32 fields, any device count/periodicity; False forces
+    the portable shard_map/XLA path; True requires the kernel and raises if
+    inapplicable.  `overlap` restructures the XLA path with
+    `igg.hide_communication`; the fused kernel has overlap semantics built
+    in, so it satisfies both settings."""
     from jax import lax
 
     kw = _pseudo_steps(params)
     dx, dy, dz = kw["dx"], kw["dy"], kw["dz"]
     mu, dtP, dtV = kw["mu"], kw["dtP"], kw["dtV"]
+    # NOTE: the step closures capture only hashable scalars so recreated
+    # closures share one compiled program (`igg.parallel._fn_key`).
 
-    def it(P, Vx, Vy, Vz, Rho):
+    def xla_it(P, Vx, Vy, Vz, Rho):
         return lax.fori_loop(
             0, n_inner,
             lambda _, S: local_iteration(*S, Rho, dx=dx, dy=dy, dz=dz,
                                          mu=mu, dtP=dtP, dtV=dtV,
-                                         overlap=overlap,
-                                         use_pallas=use_pallas,
-                                         pallas_interpret=pallas_interpret),
+                                         overlap=overlap),
             (P, Vx, Vy, Vz))
 
-    # Interpret-mode pallas kernels under shard_map trip jax's vma checking
-    # on scalar constants (same workaround as diffusion3d.make_step).
-    return igg.sharded(it, donate_argnums=(0, 1, 2, 3) if donate else (),
-                       check_vma=not (use_pallas and pallas_interpret))
+    xla_path = igg.sharded(xla_it,
+                           donate_argnums=(0, 1, 2, 3) if donate else ())
+
+    def build_pallas_steps():
+        from igg.ops import fused_stokes_iteration
+
+        def pallas_it(P, Vx, Vy, Vz, Rho):
+            return lax.fori_loop(
+                0, n_inner,
+                lambda _, S: fused_stokes_iteration(
+                    *S, Rho, dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP,
+                    dtV=dtV, interpret=pallas_interpret),
+                (P, Vx, Vy, Vz))
+
+        return pallas_it
+
+    from igg.ops import stokes_pallas_supported
+
+    from ._dispatch import auto_dispatch
+
+    return auto_dispatch(
+        use_pallas=use_pallas, interpret=pallas_interpret,
+        supported_fn=stokes_pallas_supported, requirement=_PALLAS_REQ,
+        xla_path=xla_path, build_pallas_steps=build_pallas_steps,
+        donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 def run(n_iters: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1, use_pallas: bool = False):
+        overlap: bool = False, n_inner: int = 1, use_pallas="auto"):
     """Slope-timed relaxation (see :func:`igg.time_steps`); returns fields
     and seconds/iteration."""
     P, Vx, Vy, Vz, Rho = init_fields(params, dtype=dtype)

@@ -68,6 +68,11 @@ def mega_supported(shape, bx: int, n_inner: int, interpret: bool,
         return False
     if S0 < 2 * bx:  # the wrapping edge fetches assume >= 2 slabs per step
         return False
+    if S2 % 128 != 0 or S1 % 8 != 0:
+        # Mosaic requires tile-aligned VMEM memref slices: the double-
+        # buffered scratch (2, ..., S1, S2) is sliced on its leading dim,
+        # which needs the trailing (sublane, lane) extents tile-aligned.
+        return False
     itemsize = np.dtype(dtype).itemsize
     need = itemsize * (S0 * S1 * S2       # A resident
                 + 2 * (bx + 2) * S1 * S2  # ext slabs (double-buffered)

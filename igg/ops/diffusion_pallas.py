@@ -111,6 +111,19 @@ def _u_rows(Tm, T0, Tp, A0, rdx2, rdy2, rdz2):
     return ctr + A0[:, 1:-1, 1:-1] * lap
 
 
+def _ref_taker(refs):
+    """Positional consumer for variadic kernel refs: `take = _ref_taker(refs);
+    a, b = take(2)` — shared by the fused kernels' argument unpacking."""
+    state = {"pos": 0}
+
+    def take(n):
+        out = refs[state["pos"]:state["pos"] + n]
+        state["pos"] += n
+        return out
+
+    return take
+
+
 def _make_kernel(wrap_y: bool, wrap_z: bool, scal, bx: int, nb: int):
     """Kernel factory: one x-slab program with per-dimension halo modes.
 
@@ -457,8 +470,8 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal)
 
-    # Exchanged periodic meshes — (N,1,1) x ring, or (N,M,1) with the
-    # y ring extended too — with z self-wrap: K-step trapezoidal chunks,
+    # Exchanged fully-periodic meshes — (N,1,1)/(N,M,1)/(N,M,K) rings and
+    # tori, self-wrapped or extended per dim: K-step trapezoidal chunks,
     # one K-deep slab ppermute pair per exchanged dim per K steps, the
     # loop fused in-kernel (see `diffusion_trapezoid`).  One per-step
     # kernel step runs FIRST: it consumes (and replaces) whatever is in the

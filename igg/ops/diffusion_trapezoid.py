@@ -9,17 +9,21 @@ round-trip and a collective per step
 (`/root/reference/src/update_halo.jl`'s per-step exchange, likewise).
 
 This module restores K-step fusion there with classic *trapezoidal temporal
-blocking* over the exchanged dimension(s):
+blocking* over the exchanged dimension(s) — up to the full `(N,M,K)` 3-D
+torus, the v5p BASELINE topology:
 
   1. Once per K-step chunk, each device receives the K rows beyond each end
      of its block along every exchanged dimension (ONE `ppermute` pair per
      dim moving K-deep slabs — 1/K of the per-step collective count at the
      same total bytes) and forms the extended buffer — a contiguous window
-     of the global array.  For `(N,M,1)` the extensions are built
-     dimension-sequentially: the y slabs are cut from the x-EXTENDED
-     buffer, so the corner regions arrive via the y-neighbor's own x
-     extension (the same sequential-exchange trick the halo engine uses for
-     corner propagation, `/root/reference/src/update_halo.jl:36,130`).
+     of the global array.  The extensions are built dimension-sequentially:
+     the y slabs are cut from the x-EXTENDED buffer and the z slabs from
+     the x/y-extended buffer, so corner/edge regions arrive via the later
+     neighbors' own earlier-dim extensions (the same sequential-exchange
+     trick the halo engine uses for corner propagation,
+     `/root/reference/src/update_halo.jl:36,130`).  z slabs ride the wire
+     TRANSPOSED (z on the sublane axis) so nothing lane-padded
+     materializes.
   2. ONE `pallas_call` advances K steps on the extended window (same
      VMEM-resident coefficient, HBM ping-pong, and hand double-buffered DMA
      as the mega-kernel; wrap dims keep their in-VMEM self-wrap aliases).
@@ -37,12 +41,12 @@ the redundant shoulder compute (`2K/S` per extended dim) — both amortized
 by K.
 
 Validity requires every device to have both neighbors along each extended
-dimension, i.e. fully periodic rings (`periods[d]`, any `dims[d] >= 1` —
-on one device the ring is the self-neighbor ppermute and the path is
-exercised end-to-end on a single chip).  Open boundaries keep the per-step
-path: their no-write halo semantics
-(`/root/reference/test/test_update_halo.jl:727-732`) would need per-device
-shape differences that SPMD programs cannot express.  The dispatcher in
+dimension, i.e. fully periodic rings along all three dims (`periods[d]`,
+any `dims[d] >= 1` — on one device a ring is the self-neighbor ppermute,
+handled by the in-kernel wrap, and the path is exercised end-to-end on a
+single chip).  Open boundaries keep the per-step path: their no-write halo
+semantics (`/root/reference/test/test_update_halo.jl:727-732`) would need
+per-device shape differences that SPMD programs cannot express.  The dispatcher in
 `fused_diffusion_steps` also runs one per-step kernel step BEFORE the
 chunks, which consumes never-exchanged entry halos exactly like every
 other path (bit-equivalence for ANY input).
@@ -62,19 +66,18 @@ from .diffusion_pallas import _u_rows
 
 
 def _mode(grid):
-    """(x_ok, y_ext) — x must be a periodic ring; y is either a self-wrap
-    (1 periodic device) or an extended periodic ring; z must self-wrap."""
-    x_ok = bool(grid.periods[0])
-    z_ok = grid.dims[2] == 1 and bool(grid.periods[2])
-    if not (x_ok and z_ok) or not grid.periods[1]:
-        return False, False
-    return True, grid.dims[1] > 1
+    """(ok, y_ext, z_ext) — every dimension must be a periodic ring; y/z
+    are either self-wraps (1 periodic device) or extended periodic rings.
+    Covers the full `(N,M,K)` 3-D torus (the v5p BASELINE topology)."""
+    if not all(bool(p) for p in grid.periods):
+        return False, False, False
+    return True, grid.dims[1] > 1, grid.dims[2] > 1
 
 
 def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
-                        force_y_ext=None) -> bool:
+                        force_y_ext=None, force_z_ext=None) -> bool:
     """Whether the K=bx trapezoidal chunk path applies: fully-periodic
-    x ring (and y ring when y is split), z self-wrap, at least one full
+    rings along every dimension (self-wrap or extended), at least one full
     chunk, the K-slab sends must lie inside the block, and the extended
     coefficient plus working buffers must fit in VMEM (the interpret-mode
     XLA fallback obeys the same gates so both modes take the same
@@ -83,11 +86,13 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
 
     if n_inner < bx or bx < 2:
         return False
-    ok, y_ext = _mode(grid)
+    ok, y_ext, z_ext = _mode(grid)
     if not ok:
         return False
     if force_y_ext is not None:
         y_ext = force_y_ext
+    if force_z_ext is not None:
+        z_ext = force_z_ext
     S0, S1, S2 = shape
     K = bx
     olx = grid.ol_of_local(0, shape)
@@ -95,28 +100,50 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         return False
     if S0 - olx - K < 0 or olx + K > S0:  # x send slabs inside the block
         return False
-    S1e = S1
+    if S1 % 8 != 0:
+        # Mosaic requires tile-aligned VMEM memref slices of the double-
+        # buffered scratch; sublane extent must be 8-aligned (f32).
+        return False
+    if not z_ext and S2 % 128 != 0:
+        # Ditto for the lane extent; in z-extended mode the kernel
+        # right-pads the extended extent to a 128 multiple instead.
+        return False
+    S1e, S2e = S1, S2
     if y_ext:
         oly = grid.ol_of_local(1, shape)
-        # 8-aligned K and S1 keep the extended span and the caller's
-        # central-window XLA slice on sublane-tile boundaries; the y send
-        # slabs must lie inside the block.
-        if oly < 2 or K % 8 != 0 or S1 % 8 != 0:
+        # 8-aligned K keeps the extended span and the caller's central-
+        # window XLA slice on sublane-tile boundaries (S1 alignment is
+        # gated unconditionally above); the y send slabs must lie inside
+        # the block.
+        if oly < 2 or K % 8 != 0:
             return False
         if S1 - oly - K < 0 or oly + K > S1:
             return False
         S1e = S1 + 2 * K
+    if z_ext:
+        olz = grid.ol_of_local(2, shape)
+        # No S2 alignment requirement on the caller: the extension slabs
+        # ride the wire TRANSPOSED (z on the sublane axis) so nothing
+        # lane-padded materializes, and the compiled kernel right-pads the
+        # extended lane extent to a 128 multiple (Mosaic requires aligned
+        # VMEM lane slices); the K-offset central z slice is a relayout
+        # pass amortized 1/K per step.
+        if olz < 2:
+            return False
+        if S2 - olz - K < 0 or olz + K > S2:
+            return False
+        S2e = ((S2 + 2 * K + 127) // 128) * 128
     S0e = S0 + 2 * K
     itemsize = np.dtype(dtype).itemsize
-    need = itemsize * (S0e * S1e * S2             # A_ext resident
-                       + 2 * (bx + 2) * S1e * S2    # ext slabs (dbl-buffered)
-                       + 2 * bx * S1e * S2)         # out slabs (dbl-buffered)
+    need = itemsize * (S0e * S1e * S2e            # A_ext resident
+                       + 2 * (bx + 2) * S1e * S2e   # ext slabs (dbl-buffered)
+                       + 2 * bx * S1e * S2e)        # out slabs (dbl-buffered)
     return need <= _VMEM_BUDGET
 
 
 def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
             a_vmem, ext2, o2, esems, osems, asem,
-            *, K, bx, nbe, nbo, off, S0e, S1e, S2, y_ext,
+            *, K, bx, nbe, nbo, off, S0e, S1e, S2, y_ext, z_ext,
             rdx2, rdy2, rdz2):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -209,8 +236,10 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
         # whose (garbage) values the validity argument never reads back.
         o_vmem[:, 0:1, 1:-1] = o_vmem[:, S1e - 2:S1e - 1, 1:-1]
         o_vmem[:, S1e - 1:S1e, 1:-1] = o_vmem[:, 1:2, 1:-1]
-    o_vmem[:, :, 0:1] = o_vmem[:, :, S2 - 2:S2 - 1]
-    o_vmem[:, :, S2 - 1:S2] = o_vmem[:, :, 1:2]
+    if not z_ext:
+        # z self-wrap; ditto for extended-z shoulder lanes.
+        o_vmem[:, :, 0:1] = o_vmem[:, :, S2 - 2:S2 - 1]
+        o_vmem[:, :, S2 - 1:S2] = o_vmem[:, :, 1:2]
 
     # Async write-back.  Final step: the central window goes to the real
     # output; shoulder programs park their slab in the (otherwise unused)
@@ -253,10 +282,10 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
         pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
 
 
-def _window_steps_xla(Text, A_ext, *, K, y_ext, rdx2, rdy2, rdz2):
+def _window_steps_xla(Text, A_ext, *, K, y_ext, z_ext, rdx2, rdy2, rdz2):
     """Pure-XLA realization of the chunk kernel's per-step update (interior
-    x rows; y wrap or extended; z self-wrap) — the interpret-mode fallback
-    so CPU meshes and the driver dryrun exercise the SAME chunked-exchange
+    x rows; y/z wrap or extended) — the interpret-mode fallback so CPU
+    meshes and the driver dryrun exercise the SAME chunked-exchange
     /shrinking-validity structure the TPU kernel runs (the kernel itself is
     manual-DMA and has no interpret mode)."""
     from jax import lax
@@ -269,15 +298,16 @@ def _window_steps_xla(Text, A_ext, *, K, y_ext, rdx2, rdy2, rdz2):
         if not y_ext:
             U = U.at[:, 0, 1:-1].set(U[:, S1e - 2, 1:-1])
             U = U.at[:, S1e - 1, 1:-1].set(U[:, 1, 1:-1])
-        U = U.at[:, :, 0].set(U[:, :, S2 - 2])
-        U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+        if not z_ext:
+            U = U.at[:, :, 0].set(U[:, :, S2 - 2])
+            U = U.at[:, :, S2 - 1].set(U[:, :, 1])
         return U
 
     return lax.fori_loop(0, K, step, Text)
 
 
-def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2,
-                interpret=False):
+def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, z_ext,
+                rdx2, rdy2, rdz2, interpret=False):
     """Advance K steps on the extended buffer; returns the central
     `out_shape3` window."""
     import jax
@@ -285,19 +315,35 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    S0e, S1e, S2 = Text.shape
-    S0, S1o, _ = out_shape3
+    S0e, S1e, S2e = Text.shape
+    S0, S1o, S2o = out_shape3
     if interpret:
-        out = _window_steps_xla(Text, A_ext, K=K, y_ext=y_ext,
+        out = _window_steps_xla(Text, A_ext, K=K, y_ext=y_ext, z_ext=z_ext,
                                 rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
         out = lax.slice_in_dim(out, K, K + S0, axis=0)
-        return lax.slice_in_dim(out, K, K + S1o, axis=1) if y_ext else out
+        if y_ext:
+            out = lax.slice_in_dim(out, K, K + S1o, axis=1)
+        if z_ext:
+            out = lax.slice_in_dim(out, K, K + S2o, axis=2)
+        return out
+    if z_ext and S2e % 128 != 0:
+        # Mosaic requires 128-aligned VMEM lane slices; right-pad the
+        # extended lane extent with zeros.  The garbage lanes lie beyond
+        # the +K extension: their invalidity front reaches exactly lane
+        # K+S2o after K steps, never entering the central window.
+        import jax.numpy as jnp
+
+        S2p = ((S2e + 127) // 128) * 128
+        pad = [(0, 0), (0, 0), (0, S2p - S2e)]
+        Text = jnp.pad(Text, pad)
+        A_ext = jnp.pad(A_ext, pad)
+        S2e = S2p
     assert K == bx, "chunk depth is pinned to the block row count"
     nbe = S0e // bx
     nbo = S0 // bx
     off = 1  # = K // bx
     kern = partial(_kernel, K=K, bx=bx, nbe=nbe, nbo=nbo, off=off,
-                   S0e=S0e, S1e=S1e, S2=S2, y_ext=y_ext,
+                   S0e=S0e, S1e=S1e, S2=S2e, y_ext=y_ext, z_ext=z_ext,
                    rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
 
     vmas = [getattr(getattr(x, "aval", None), "vma", None)
@@ -314,14 +360,14 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
-        out_shape=[shp((S0, S1e, S2)), shp(Text.shape), shp(Text.shape)],
+        out_shape=[shp((S0, S1e, S2e)), shp(Text.shape), shp(Text.shape)],
         # Text is dead after the k=0 reads; buf1 (first written at k=1)
         # reuses its buffer.
         input_output_aliases={0: 2},
         scratch_shapes=[
             pltpu.VMEM(Text.shape, Text.dtype),             # a_vmem
-            pltpu.VMEM((2, bx + 2, S1e, S2), Text.dtype),   # ext2
-            pltpu.VMEM((2, bx, S1e, S2), Text.dtype),       # o2
+            pltpu.VMEM((2, bx + 2, S1e, S2e), Text.dtype),  # ext2
+            pltpu.VMEM((2, bx, S1e, S2e), Text.dtype),      # o2
             pltpu.SemaphoreType.DMA((2,)),                  # esems
             pltpu.SemaphoreType.DMA((2,)),                  # osems
             pltpu.SemaphoreType.DMA,                        # asem
@@ -333,6 +379,10 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, rdx2, rdy2, rdz2,
     if y_ext:
         # Central y window (tile-aligned K offset: a cheap slab slice).
         out = lax.slice_in_dim(out, K, K + S1o, axis=1)
+    if z_ext:
+        # Central z window (lane-dim slice, one relayout pass per chunk —
+        # amortized 1/K per step).
+        out = lax.slice_in_dim(out, K, K + S2o, axis=2)
     return out
 
 
@@ -346,7 +396,13 @@ def _extend_dim(T, K, ol, grid, d):
     makes the window exchange-fresh at chunk entry — the invariant the
     trapezoidal validity argument needs.  When the entry halos are already
     fresh (any state produced by `update_halo`, a model step, or a previous
-    chunk) the replacement is a bit-exact no-op."""
+    chunk) the replacement is a bit-exact no-op.
+
+    z slabs (`d == 2`) ride the wire TRANSPOSED — `(S0, K+1, S1)` with z on
+    the sublane axis — because a materialized `(S0, S1, K+1)` array is
+    lane-padded to 128 (~14x its logical HBM footprint at K=8); the
+    transpose back into the lane-dim concatenate stays inside one XLA
+    fusion, so nothing lane-padded reaches HBM or the ICI links."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -362,44 +418,58 @@ def _extend_dim(T, K, ol, grid, d):
     if n > 1:
         to_right = [(i, (i + 1) % n) for i in range(n)]
         to_left = [(i, (i - 1) % n) for i in range(n)]
+        tw = d == 2 and T.ndim == 3   # transpose-carried lane-dim slabs
+        if tw:
+            left_slab = jnp.swapaxes(left_slab, 1, 2)
+            right_slab = jnp.swapaxes(right_slab, 1, 2)
         left_slab = lax.ppermute(left_slab, axis, to_right)
         right_slab = lax.ppermute(right_slab, axis, to_left)
+        if tw:
+            left_slab = jnp.swapaxes(left_slab, 1, 2)
+            right_slab = jnp.swapaxes(right_slab, 1, 2)
     return jnp.concatenate(
         [left_slab, lax.slice_in_dim(T, 1, S - 1, axis=d), right_slab],
         axis=d)
 
 
-def _extend(T, K, grid, shape, y_ext):
-    """x extension, then (for split y) the y extension OF the x-extended
-    buffer — corners arrive via the y-neighbor's own x extension."""
+def _extend(T, K, grid, shape, y_ext, z_ext):
+    """x extension, then (for split y/z) the y extension OF the x-extended
+    buffer and the z extension of the x/y-extended buffer — corner and edge
+    regions arrive via the later neighbors' own earlier-dim extensions (the
+    sequential-exchange corner trick)."""
     Text = _extend_dim(T, K, grid.ol_of_local(0, shape), grid, 0)
     if y_ext:
         Text = _extend_dim(Text, K, grid.ol_of_local(1, shape), grid, 1)
+    if z_ext:
+        Text = _extend_dim(Text, K, grid.ol_of_local(2, shape), grid, 2)
     return Text
 
 
 def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
                                     grid, rdx2, rdy2, rdz2,
-                                    force_y_ext=None, interpret=False):
+                                    force_y_ext=None, force_z_ext=None,
+                                    interpret=False):
     """Advance `n_inner` steps in chunks of K=bx trapezoidal kernel calls
     (plus a per-step remainder handled by the caller; this function runs
     only the `n_inner // bx` full chunks and returns `(T, steps_done)`).
-    `force_y_ext` overrides the mesh-derived y mode (benchmarking the
-    `(N,M,1)` program shape on a 1-device self-torus)."""
+    `force_y_ext`/`force_z_ext` override the mesh-derived modes
+    (benchmarking the `(N,M,K)` program shapes on a 1-device self-torus)."""
     from jax import lax
 
     K = bx
     shape = T.shape
-    _, y_ext = _mode(grid)
+    _, y_ext, z_ext = _mode(grid)
     if force_y_ext is not None:
         y_ext = force_y_ext
+    if force_z_ext is not None:
+        z_ext = force_z_ext
     chunks = n_inner // K
-    A_ext = _extend(A, K, grid, shape, y_ext)   # loop-invariant
+    A_ext = _extend(A, K, grid, shape, y_ext, z_ext)   # loop-invariant
 
     def one(_, T):
-        Text = _extend(T, K, grid, shape, y_ext)
+        Text = _extend(T, K, grid, shape, y_ext, z_ext)
         return _chunk_call(Text, A_ext, shape, K=K, bx=bx, y_ext=y_ext,
-                           rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
+                           z_ext=z_ext, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
                            interpret=interpret)
 
     T = lax.fori_loop(0, chunks, one, T)

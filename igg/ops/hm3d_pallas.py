@@ -172,11 +172,14 @@ def _boundary_slabs(Pe, phi, wrap_yz):
     return (pe[0], pe[1], ph[0], ph[1], pe[2], pe[3], ph[2], ph[3])
 
 
-def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int):
+def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int,
+                 emit_slabs: bool):
     """Kernel factory: one x-slab program computing both coupled updates and
     assembling halos in dimension order (x planes first, then y rows, then z
     columns — later dimensions own the shared corner/edge cells, realizing
-    `/root/reference/src/update_halo.jl:36,130`)."""
+    `/root/reference/src/update_halo.jl:36,130`).  `emit_slabs` adds the
+    compact boundary-slab outputs consumed by the slab-carry loop; the
+    single-step entry skips them (no consumer)."""
     from jax.experimental import pallas as pl
 
     n_planes_y = 0 if wrap_y else 4
@@ -186,15 +189,9 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int):
         import jax.numpy as jnp
 
         from ..models.hm3d import step_core
+        from .diffusion_pallas import _ref_taker
 
-        pos = 0
-
-        def take(n):
-            nonlocal pos
-            out = refs[pos:pos + n]
-            pos += n
-            return out
-
+        take = _ref_taker(refs)
         m1, cPe, p1 = take(3)
         ePe = jnp.concatenate([m1[:], cPe[:], p1[:]], axis=0)
         m1, cphi, p1 = take(3)
@@ -203,8 +200,8 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int):
         y_in = take(n_planes_y)                   # (pe_f, pe_l, phi_f, phi_l)
         z_in = take(n_planes_z)
         oPe, ophi = take(2)
-        y_out = take(0 if wrap_y else 4)          # (pe_lo, pe_hi, phi_lo, phi_hi)
-        z_out = take(0 if wrap_z else 4)
+        y_out = take(4 if emit_slabs and not wrap_y else 0)
+        z_out = take(4 if emit_slabs and not wrap_z else 0)
 
         dPe, dphi = step_core(ePe, ephi, **kw_core)
 
@@ -251,12 +248,12 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int):
 
         # Compact boundary slabs of the assembled outputs for the recv-mode
         # dims (consumed by the slab-carry loop); z TRANSPOSED (bx,3,S1).
-        if not wrap_y:
+        if y_out:
             y_out[0][:] = oPe[:, 0:3, :]
             y_out[1][:] = oPe[:, S1 - 3:S1, :]
             y_out[2][:] = ophi[:, 0:3, :]
             y_out[3][:] = ophi[:, S1 - 3:S1, :]
-        if not wrap_z:
+        if z_out:
             for j in range(3):
                 z_out[0][:, j, :] = oPe[:, :, j]
                 z_out[1][:, j, :] = oPe[:, :, S2 - 3 + j]
@@ -266,10 +263,12 @@ def _make_kernel(wrap_y: bool, wrap_z: bool, kw_core, bx: int, nb: int):
     return kernel
 
 
-def _call_kernel(Pe, phi, recvs, kw_core, bx, interpret, wrap_yz):
+def _call_kernel(Pe, phi, recvs, kw_core, bx, interpret, wrap_yz,
+                 emit_slabs: bool = True):
     """pallas_call plumbing: returns `(Pe', phi', *slabs)` where `slabs` are
     the recv-mode boundary-slab outputs in (y: pe_lo, pe_hi, phi_lo, phi_hi;
-    z: same transposed) order — wrap dims emit none."""
+    z: same transposed) order — wrap dims emit none, and `emit_slabs=False`
+    (the single-step entry) emits none at all."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -282,7 +281,7 @@ def _call_kernel(Pe, phi, recvs, kw_core, bx, interpret, wrap_yz):
     rq = [{d: (jnp.squeeze(a, d), jnp.squeeze(b, d))
            for d, (a, b) in r.items()} for r in recvs]
 
-    kern = _make_kernel(wy, wz, kw_core, bx, nb)
+    kern = _make_kernel(wy, wz, kw_core, bx, nb, emit_slabs)
     kwargs = {}
     if not interpret:
         from jax.experimental.pallas import tpu as pltpu
@@ -318,10 +317,10 @@ def _call_kernel(Pe, phi, recvs, kw_core, bx, interpret, wrap_yz):
 
     out_shape = [shp(S0, S1, S2)] * 2
     out_specs = [pl.BlockSpec((bx, S1, S2), lambda i: (i, 0, 0))] * 2
-    if not wy:
+    if emit_slabs and not wy:
         out_shape += [shp(S0, 3, S2)] * 4
         out_specs += [pl.BlockSpec((bx, 3, S2), lambda i: (i, 0, 0))] * 4
-    if not wz:
+    if emit_slabs and not wz:
         out_shape += [shp(S0, 3, S1)] * 4   # transposed z slabs
         out_specs += [pl.BlockSpec((bx, 3, S1), lambda i: (i, 0, 0))] * 4
     return pl.pallas_call(
@@ -361,7 +360,9 @@ def fused_hm3d_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
     wrap_yz = _wrap_dims(grid)
     slabs = _boundary_slabs(Pe, phi, wrap_yz)
     recvs = _exchange(Pe, phi, slabs, kw, grid, dims_active, wrap_yz)
-    return _call_kernel(Pe, phi, recvs, kw, bx, interpret, wrap_yz)[:2]
+    Pe2, phi2 = _call_kernel(Pe, phi, recvs, kw, bx, interpret, wrap_yz,
+                             emit_slabs=False)
+    return Pe2, phi2
 
 
 def fused_hm3d_steps(Pe, phi, *, n_inner, dx, dy, dz, dt, phi0, npow, eta,

@@ -1,4 +1,4 @@
-"""Fused Pallas Stokes iteration (self-wrap single-device grids).
+"""Fused Pallas Stokes iteration — mesh-capable (any dims / periodicity).
 
 One `pallas_call` performs a full pseudo-transient Stokes iteration —
 pressure update, six stresses, three momentum residuals, velocity updates,
@@ -12,43 +12,70 @@ traffic is the ideal 5 reads + 4 writes.
 
 This is the TPU re-expression of the reference's native-kernel performance
 tier (">10x faster" than the array-broadcast form,
-`/root/reference/README.md:161`) for BASELINE config 5's Stokes solver.
+`/root/reference/README.md:161`) for BASELINE config 5's Stokes solver, on
+*every* rank of a decomposed run — the per-rank property of the
+reference's native tier — not just the single-device configuration.
 
-Measured on v5e at 128^3 f32 (median-of-3, 100-iteration dispatches):
-**0.136 ms/iter** vs 0.269 for the XLA composition with the round-3 halo
-engine (2.0x) and 0.303 for round 2's (2.2x); matches the XLA path
-BITWISE on the chip (identical `iteration_core` arithmetic).  The DMA
-floor of this structure measured with a no-op core is 0.108 ms (~790 GB/s
-on ~85 MB/iter of traffic, including the 2x lane padding of Vz's
-(S,S,S+1) shape), so the remaining gap to ideal is non-overlapped VPU
-time.
+Measured on v5e at 128^3 f32 (median-of-3, 100-iteration dispatches,
+self-wrap grid): **0.136 ms/iter** vs 0.269 for the XLA composition with
+the round-3 halo engine (2.0x); matches the XLA path BITWISE on the chip
+(identical `iteration_core` arithmetic).  The DMA floor of this structure
+measured with a no-op core is 0.108 ms (~790 GB/s on ~85 MB/iter of
+traffic, including the 2x lane padding of Vz's (S,S,S+1) shape), so the
+remaining gap to ideal is non-overlapped VPU time.
 
-Structure (mirrors `diffusion_pallas`, radius-2 Gauss-Seidel variant):
-  - grid over x-slabs of `bx` rows; each program reads its slab plus 2 (3
-    for the x-staggered Vx) margin rows per side as single-row block refs
-    with modular index maps — edge programs read wrapped rows whose results
-    land only in halo rows that the halo phase overwrites;
-  - the slab arithmetic is LITERALLY `stokes3d.iteration_core` — one source
-    of truth with the XLA path, so the two agree to Mosaic-vs-XLA rounding;
-  - x halo planes cross program boundaries, so they are precomputed in XLA
-    from the two 5-row x-end windows (same `iteration_core`; contiguous
-    dim-0 slices, ~2 MB of reads) and written by the edge programs; y/z
-    halos are in-VMEM self-wrap aliases (each field's own staggered
-    overlap `ol`, reference `/root/reference/src/shared.jl:81`);
-  - Vx's extra global row `S0` lies outside the block grid; it is a halo
-    row (`Vx[S0] = Vx[ol-1]`) written by one cheap dim-0 DUS after the
-    kernel.
+Structure (the radius-2 staggered four-field instance of the
+`diffusion_pallas` recipe):
 
-Requirements: single device, all dimensions periodic (the reference's
-single-process fully-periodic configuration,
-`/root/reference/src/update_halo.jl:516-532`), overlap 3 everywhere (the
-radius-2 chain), float inputs of equal dtype.  Other configurations fall
-back to the XLA path.
+1. **Send planes from thin-window recomputation** — the updated inner
+   boundary planes `ol-1` / `s-ol` of each exchanged field (per-field
+   staggered `ol`, reference `/root/reference/src/shared.jl:81`) are
+   produced by `compute_iteration` on 5-cell-row windows (the staggered
+   field of the window's axis contributes 6 face rows), O(s²) work
+   data-independent of the main kernel.  z windows are computed TRANSPOSED
+   (axes 1<->2, Vy/Vz slots and dy/dz swapped, `buoy_axis=1` keeping the
+   buoyancy on physical Vz), yielding the squeezed z planes directly — a
+   `(S0,S1,5)` window would be lane-padded ~26x through the whole radius-2
+   temporary chain.
+2. **Dimension-sequential plane exchange** — `exchange_all_dims_grouped`
+   over the four fields (P and Vx share plane shapes and ride one
+   ppermute; Vy/Vz planes are staggered-shaped), with corner/edge
+   propagation, open-boundary stale fallbacks, and self-wrap local copies
+   (`/root/reference/src/update_halo.jl:36,130,516-532`).
+3. **Fused compute + assembly kernel** — grid over x-slabs of `bx` rows;
+   each program reads its slab plus 2 (3 for the x-staggered Vx) margin
+   rows per side as single-row block refs with modular index maps — edge
+   programs read wrapped rows whose results land only in halo rows that
+   the halo phase overwrites.  The slab arithmetic is LITERALLY
+   `stokes3d.iteration_core` — one source of truth with the XLA path.
+   Received planes are assembled in dimension order: x planes by the edge
+   programs, then y rows, then z columns winning the shared corners.
+   Per-dimension halo modes as in `diffusion_pallas`: y/z dims periodic
+   with a single device are in-VMEM self-wrap aliases (per-field staggered
+   `ol`); exchanged or open dims take received/stale planes as blocked
+   inputs.  Vx's extra global row `S0` lies outside the block grid; it is
+   the x-side `s-1` halo row, assembled after the kernel from the received
+   x plane with the y/z updates applied on top (one cheap dim-0 DUS).
+
+Semantics match :func:`igg.hide_communication` exactly — which for the
+slice-based `iteration_core` means identical to the plain sequential
+composition *everywhere*, including the open-boundary planes that the
+full-shape pressure update writes (the no-write fallback planes are
+window-computed, see `_sends_and_stales`); decomposition invariance holds
+on any mesh.
+
+Requirements: overlap 3 (the radius-2 chain), unstaggered-pressure 3-D
+local blocks large enough to slab, equal f32 dtypes; any device count and
+periodicity.  Multi-device z decompositions pay a per-iteration strided
+z-window extraction (~2 lane-tile passes); prefer `(N,1,1)`/`(N,M,1)`
+meshes where z stays device-local, as with the diffusion kernel.
 """
 
 from __future__ import annotations
 
 from functools import partial
+
+from .diffusion_pallas import _wrap_dims, _wrap_set
 
 # Deliberately TIGHT: the scoped-vmem budget steers Mosaic's scheduling, and
 # a small budget produces far better DMA/compute interleaving for this
@@ -59,11 +86,10 @@ _VMEM_LIMIT = 32 * 1024 * 1024
 
 
 def stokes_pallas_supported(grid, P) -> bool:
-    """Whether the fused iteration applies: self-wrap fully-periodic
-    single-device grid with overlap 3, unstaggered-pressure local block
-    large enough to slab."""
-    if tuple(grid.dims) != (1, 1, 1) or not all(bool(p) for p in grid.periods):
-        return False
+    """Whether the fused iteration applies: overlap-3 grid (any device
+    count and any periodicity — the exchange engine handles open boundaries
+    and multi-device meshes), unstaggered-pressure local block large enough
+    to slab."""
     if grid.overlaps != (3, 3, 3) or P.ndim != 3:
         return False
     s = tuple(grid.local_shape_any(P))
@@ -72,54 +98,136 @@ def stokes_pallas_supported(grid, P) -> bool:
     return s[0] % 8 == 0 and s[0] >= 16 and s[1] >= 8 and s[2] >= 8
 
 
-def _windows(P, Vx, Vy, Vz, Rho, scal):
-    """The seven x-halo planes (and Vx's outside row) from the two 5-row
-    x-end windows, via `compute_iteration` on contiguous dim-0 slices."""
+def _win_x(P, Vx, Vy, Vz, Rho, scal, lo, hi):
+    """`compute_iteration` on the contiguous x window of cell rows
+    [lo, hi) (Vx contributes hi+1 face rows): valid updated cell rows are
+    the window interior."""
     from jax import lax
 
     from ..models.stokes3d import compute_iteration
 
-    S0 = P.shape[0]
-
-    def win(lo, hi):
-        cut = lambda A: lax.slice_in_dim(A, lo, hi, axis=0)
-        cutx = lambda A: lax.slice_in_dim(A, lo, hi + 1, axis=0)
-        return compute_iteration(cut(P), cutx(Vx), cut(Vy), cut(Vz),
-                                 cut(Rho), **scal)
-
-    Pw, Vxw, Vyw, Vzw = win(S0 - 5, S0)       # rows S0-5 .. S0-1 (cells)
-    first = (Pw[2], Vxw[2], Vyw[2], Vzw[2])   # global row S0-3 = s-ol
-    Pw, Vxw, Vyw, Vzw = win(0, 5)             # rows 0..4
-    last = (Pw[2], Vyw[2], Vzw[2])            # global row ol-1 = 2
-    vx_outside = Vxw[3]                       # Vx[S0] = Vx[ol_x-1] = Vx[3]
-    return first, last, vx_outside
+    cut = lambda A: lax.slice_in_dim(A, lo, hi, axis=0)
+    cutx = lambda A: lax.slice_in_dim(A, lo, hi + 1, axis=0)
+    return compute_iteration(cut(P), cutx(Vx), cut(Vy), cut(Vz), cut(Rho),
+                             **scal)
 
 
-def _kernel(*refs, bx, nb, shapes, scal):
+def _win_y(P, Vx, Vy, Vz, Rho, scal, lo, hi):
+    from jax import lax
+
+    from ..models.stokes3d import compute_iteration
+
+    cut = lambda A: lax.slice_in_dim(A, lo, hi, axis=1)
+    cuty = lambda A: lax.slice_in_dim(A, lo, hi + 1, axis=1)
+    return compute_iteration(cut(P), cut(Vx), cuty(Vy), cut(Vz), cut(Rho),
+                             **scal)
+
+
+def _win_z(P, Vx, Vy, Vz, Rho, scal, lo, hi):
+    """TRANSPOSED z window: axes 1<->2, Vy/Vz slots and dy/dz swapped,
+    buoyancy kept on physical Vz via `buoy_axis=1`.  Returns the updated
+    transposed windows in PHYSICAL field order (P, Vx, Vy, Vz)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..models.stokes3d import compute_iteration
+
+    cut = lambda A: jnp.swapaxes(lax.slice_in_dim(A, lo, hi, axis=2), 1, 2)
+    cutz = lambda A: jnp.swapaxes(lax.slice_in_dim(A, lo, hi + 1, axis=2),
+                                  1, 2)
+    swapped = dict(scal)
+    swapped["dy"], swapped["dz"] = scal["dz"], scal["dy"]
+    Pt, Vxt, Vzt, Vyt = compute_iteration(
+        cut(P), cut(Vx), cutz(Vz), cut(Vy), cut(Rho), **swapped,
+        buoy_axis=1)
+    return Pt, Vxt, Vyt, Vzt
+
+
+def _sends_and_stales(P, Vx, Vy, Vz, Rho, scal, wrap_yz):
+    """Keepdims send planes (updated inner planes `ol-1` / `s-ol`, staggered
+    per field) and the open-boundary no-write fallback planes for the four
+    exchanged fields, as parallel lists of `{(dim, side): plane}` dicts for
+    `exchange_all_dims_grouped`.  Wrapped y/z dims need neither.
+
+    The fallback planes are the *window-computed* outermost planes, NOT the
+    pre-iteration ones: the full-shape pressure update writes its outermost
+    planes too, and the plain composition (reference no-write semantics,
+    `/root/reference/test/test_update_halo.jl:727-732`) keeps those computed
+    values at an open boundary.  Window row values equal full-array row
+    values because `iteration_core` is slice-based (see `igg.overlap`,
+    same contract)."""
+    import jax.numpy as jnp
+
+    wy, wz = wrap_yz
+    S0, S1, S2 = P.shape
+    sends = [{}, {}, {}, {}]
+    stales = [{}, {}, {}, {}]
+
+    def put(side, d, planes, stale_planes):
+        for i, pl_ in enumerate(planes):
+            sends[i][(d, side)] = pl_
+        for i, pl_ in enumerate(stale_planes):
+            stales[i][(d, side)] = pl_
+
+    # x: low window cells [0,5) -> updated row 2 (= ol-1) for P/Vy/Vz, row 3
+    # for the x-staggered Vx (ol=4); high window cells [S0-5,S0) -> updated
+    # row S0-3 (= s-ol) for every field.  Fallbacks: the windows' outermost
+    # updated planes (local 0 low; local 4, or 5 for the staggered field,
+    # high).
+    Pw, Vxw, Vyw, Vzw = _win_x(P, Vx, Vy, Vz, Rho, scal, 0, 5)
+    put(0, 0, (Pw[2:3], Vxw[3:4], Vyw[2:3], Vzw[2:3]),
+        (Pw[0:1], Vxw[0:1], Vyw[0:1], Vzw[0:1]))
+    Pw, Vxw, Vyw, Vzw = _win_x(P, Vx, Vy, Vz, Rho, scal, S0 - 5, S0)
+    put(1, 0, (Pw[2:3], Vxw[2:3], Vyw[2:3], Vzw[2:3]),
+        (Pw[4:5], Vxw[5:6], Vyw[4:5], Vzw[4:5]))
+
+    if not wy:
+        Pw, Vxw, Vyw, Vzw = _win_y(P, Vx, Vy, Vz, Rho, scal, 0, 5)
+        put(0, 1, (Pw[:, 2:3], Vxw[:, 2:3], Vyw[:, 3:4], Vzw[:, 2:3]),
+            (Pw[:, 0:1], Vxw[:, 0:1], Vyw[:, 0:1], Vzw[:, 0:1]))
+        Pw, Vxw, Vyw, Vzw = _win_y(P, Vx, Vy, Vz, Rho, scal, S1 - 5, S1)
+        put(1, 1, (Pw[:, 2:3], Vxw[:, 2:3], Vyw[:, 2:3], Vzw[:, 2:3]),
+            (Pw[:, 4:5], Vxw[:, 4:5], Vyw[:, 5:6], Vzw[:, 4:5]))
+    if not wz:
+        ex = lambda W, j: jnp.expand_dims(W[:, j, :], 2)
+        Pw, Vxw, Vyw, Vzw = _win_z(P, Vx, Vy, Vz, Rho, scal, 0, 5)
+        put(0, 2, (ex(Pw, 2), ex(Vxw, 2), ex(Vyw, 2), ex(Vzw, 3)),
+            (ex(Pw, 0), ex(Vxw, 0), ex(Vyw, 0), ex(Vzw, 0)))
+        Pw, Vxw, Vyw, Vzw = _win_z(P, Vx, Vy, Vz, Rho, scal, S2 - 5, S2)
+        put(1, 2, (ex(Pw, 2), ex(Vxw, 2), ex(Vyw, 2), ex(Vzw, 2)),
+            (ex(Pw, 4), ex(Vxw, 4), ex(Vyw, 4), ex(Vzw, 5)))
+    return sends, stales
+
+
+def _kernel(*refs, bx, nb, shapes, scal, wrap_y, wrap_z):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     from ..models.stokes3d import iteration_core
+    from .diffusion_pallas import _ref_taker
 
-    it = iter(refs)
+    take = _ref_taker(refs)
+
     # Extended slabs: rows [a-1, a+bx+1) of each field (the x-staggered Vx
     # one row more).  Minimal margins — out rows that would read beyond them
     # are halo rows overwritten below.  Rho is read row-locally, so its
     # margin rows are dummies taken from the center block (values unused).
-    m1, cP, p1 = next(it), next(it), next(it)
+    m1, cP, p1 = take(3)
     eP = jnp.concatenate([m1[:], cP[:], p1[:]], axis=0)
-    m1, cVx, p1, p2 = next(it), next(it), next(it), next(it)
+    m1, cVx, p1, p2 = take(4)
     eVx = jnp.concatenate([m1[:], cVx[:], p1[:], p2[:]], axis=0)
-    m1, cVy, p1 = next(it), next(it), next(it)
+    m1, cVy, p1 = take(3)
     eVy = jnp.concatenate([m1[:], cVy[:], p1[:]], axis=0)
-    m1, cVz, p1 = next(it), next(it), next(it)
+    m1, cVz, p1 = take(3)
     eVz = jnp.concatenate([m1[:], cVz[:], p1[:]], axis=0)
-    cRho = next(it)
+    (cRho,) = take(1)
     r = cRho[:]
     eRho = jnp.concatenate([r[0:1], r, r[0:1]], axis=0)
-    pf, vxf, vyf, vzf = (next(it) for _ in range(4))   # first planes
-    pl_, vyl, vzl = (next(it) for _ in range(3))       # last planes
-    oP, oVx, oVy, oVz = (next(it) for _ in range(4))
+    pf, vxf, vyf, vzf = take(4)        # x first planes (squeezed)
+    pl_, vyl, vzl = take(3)            # x last planes (Vx's is post-kernel)
+    y_in = take(0 if wrap_y else 8)    # (P f,l, Vx f,l, Vy f,l, Vz f,l)
+    z_in = take(0 if wrap_z else 8)
+    oP, oVx, oVy, oVz = take(4)
 
     Pn, dVx, dVy, dVz = iteration_core(eP, eVx, eVy, eVz, eRho, **scal)
 
@@ -149,28 +257,57 @@ def _kernel(*refs, bx, nb, shapes, scal):
         # Vx's last halo row is global row S0, outside the block grid —
         # written by the caller after the kernel.
 
-    # y then z self-wrap (per-field staggered ol: 4 on the staggered axis).
-    for o_ref, (_, sy, sz), oly, olz in (
-            (oP, shapes[0], 3, 3), (oVx, shapes[1], 3, 3),
-            (oVy, shapes[2], 4, 3), (oVz, shapes[3], 3, 4)):
-        o_ref[:, 0:1, :] = o_ref[:, sy - oly:sy - oly + 1, :]
-        o_ref[:, sy - 1:sy, :] = o_ref[:, oly - 1:oly, :]
-        o_ref[:, :, 0:1] = o_ref[:, :, sz - olz:sz - olz + 1]
-        o_ref[:, :, sz - 1:sz] = o_ref[:, :, olz - 1:olz]
+    # y halo rows (full x/z extent; z writes own the shared cells below).
+    if wrap_y:
+        for o_ref, (_, sy, sz), oly in ((oP, shapes[0], 3),
+                                        (oVx, shapes[1], 3),
+                                        (oVy, shapes[2], 4),
+                                        (oVz, shapes[3], 3)):
+            o_ref[:, 0:1, :] = o_ref[:, sy - oly:sy - oly + 1, :]
+            o_ref[:, sy - 1:sy, :] = o_ref[:, oly - 1:oly, :]
+    else:
+        for o_ref, (_, sy, _), f, l in (
+                (oP, shapes[0], y_in[0], y_in[1]),
+                (oVx, shapes[1], y_in[2], y_in[3]),
+                (oVy, shapes[2], y_in[4], y_in[5]),
+                (oVz, shapes[3], y_in[6], y_in[7])):
+            o_ref[:, 0:1, :] = jnp.expand_dims(f[:], 1)
+            o_ref[:, sy - 1:sy, :] = jnp.expand_dims(l[:], 1)
+    # z halo columns (own all shared corners).
+    if wrap_z:
+        for o_ref, (_, _, sz), olz in ((oP, shapes[0], 3),
+                                       (oVx, shapes[1], 3),
+                                       (oVy, shapes[2], 3),
+                                       (oVz, shapes[3], 4)):
+            o_ref[:, :, 0:1] = o_ref[:, :, sz - olz:sz - olz + 1]
+            o_ref[:, :, sz - 1:sz] = o_ref[:, :, olz - 1:olz]
+    else:
+        for o_ref, (_, _, sz), f, l in (
+                (oP, shapes[0], z_in[0], z_in[1]),
+                (oVx, shapes[1], z_in[2], z_in[3]),
+                (oVy, shapes[2], z_in[4], z_in[5]),
+                (oVz, shapes[3], z_in[6], z_in[7])):
+            o_ref[:, :, 0:1] = jnp.expand_dims(f[:], 2)
+            o_ref[:, :, sz - 1:sz] = jnp.expand_dims(l[:], 2)
 
 
 def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
                            bx: int = 8, interpret: bool = False):
     """One fused Stokes pseudo-transient iteration
     `(P, Vx, Vy, Vz, Rho) -> (P', Vx', Vy', Vz')` with halo maintenance
-    included, on a self-wrap grid (see module docstring).  Matches
-    `stokes3d.local_iteration(..., overlap=False)` to Mosaic-vs-XLA
-    rounding."""
+    included, on any mesh (see module docstring).  Call inside SPMD code
+    (`igg.sharded` / shard_map); on a 1-device grid the exchange
+    degenerates to local copies and the function also works under plain
+    `jax.jit`.  Matches `stokes3d.local_iteration(..., overlap=True)` to
+    Mosaic-vs-XLA rounding (overlap semantics are built in)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
     from jax.experimental import pallas as pl
 
+    from .. import shared
+    from ..halo import active_dims, exchange_all_dims_grouped
+
+    grid = shared.global_grid()
     S0, S1, S2 = P.shape
     while S0 % bx != 0:
         bx //= 2
@@ -179,8 +316,18 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
     nb = S0 // bx
     scal = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
     shapes = [P.shape, Vx.shape, Vy.shape, Vz.shape, Rho.shape]
+    wrap_yz = _wrap_dims(grid)
+    wy, wz = wrap_yz
+    wrap = _wrap_set(wrap_yz)
 
-    first, last, vx_outside = _windows(P, Vx, Vy, Vz, Rho, scal)
+    fields = [P, Vx, Vy, Vz]
+    sends, stales = _sends_and_stales(P, Vx, Vy, Vz, Rho, scal, wrap_yz)
+    dims_actives = [active_dims(F.shape, grid) for F in fields]
+    recvs = exchange_all_dims_grouped(
+        [F.shape for F in fields], sends, dims_actives, grid,
+        stales=stales, wraps=[wrap] * 4, blocks=fields)
+    rq = [{d: (jnp.squeeze(a, d), jnp.squeeze(b, d))
+           for d, (a, b) in r.items()} for r in recvs]
 
     operands, in_specs = [], []
     for F in (P, Vx, Vy, Vz, Rho):
@@ -201,9 +348,27 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
                 in_specs.append(pl.BlockSpec(
                     (1, *yz),
                     lambda i, rr=r, ss=sx: ((i * bx + rr) % ss, 0, 0)))
-    for pln in (*first, *last):
+    # x planes: first of all four fields, last of P/Vy/Vz (Vx's handled
+    # after the kernel).
+    x_planes = [rq[0][0][0], rq[1][0][0], rq[2][0][0], rq[3][0][0],
+                rq[0][0][1], rq[2][0][1], rq[3][0][1]]
+    for pln in x_planes:
         operands.append(pln)
         in_specs.append(pl.BlockSpec(pln.shape, lambda i: (0, 0)))
+    if not wy:
+        for k in range(4):
+            for side in (0, 1):
+                pln = rq[k][1][side]        # squeezed (sx, S2)
+                operands.append(pln)
+                in_specs.append(pl.BlockSpec((bx, pln.shape[1]),
+                                             lambda i: (i, 0)))
+    if not wz:
+        for k in range(4):
+            for side in (0, 1):
+                pln = rq[k][2][side]        # squeezed (sx, sy)
+                operands.append(pln)
+                in_specs.append(pl.BlockSpec((bx, pln.shape[1]),
+                                             lambda i: (i, 0)))
 
     vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in operands]
     vma = frozenset().union(*[v for v in vmas if v])
@@ -226,7 +391,8 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
             dimension_semantics=("parallel",))
 
     Pn, Vxn, Vyn, Vzn = pl.pallas_call(
-        partial(_kernel, bx=bx, nb=nb, shapes=shapes[:4], scal=scal),
+        partial(_kernel, bx=bx, nb=nb, shapes=shapes[:4], scal=scal,
+                wrap_y=wy, wrap_z=wz),
         grid=(nb,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -235,17 +401,33 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
         **kwargs,
     )(*operands)
 
-    # Vx's outside halo row (global S0): the sequential-dimension semantics
-    # give it the updated row `ol-1` with the y/z self-wraps applied on top
-    # (the later exchanges span the full x extent including this row).
-    def wrap_row(v, axis, size, ol):
-        idx = lax.broadcasted_iota(jnp.int32, v.shape, axis)
-        v = jnp.where(idx == 0, lax.slice_in_dim(v, size - ol, size - ol + 1,
-                                                 axis=axis), v)
-        return jnp.where(idx == size - 1,
-                         lax.slice_in_dim(v, ol - 1, ol, axis=axis), v)
+    # Vx's outside halo row (global S0): the x-side `s-1` halo row, from the
+    # received x plane, with the later dimensions' updates applied on top —
+    # the sequential-dimension semantics for a row outside the block grid.
+    vx_out = rq[1][0][1]                   # (S1, S2)
+    if wy:
+        vx_out = _wrap_row(vx_out, 0, S1, 3)
+    else:
+        vx_out = vx_out.at[0, :].set(rq[1][1][0][S0, :])
+        vx_out = vx_out.at[S1 - 1, :].set(rq[1][1][1][S0, :])
+    if wz:
+        vx_out = _wrap_row(vx_out, 1, S2, 3)
+    else:
+        vx_out = vx_out.at[:, 0].set(rq[1][2][0][S0, :])
+        vx_out = vx_out.at[:, S2 - 1].set(rq[1][2][1][S0, :])
+    from jax import lax
 
-    vx_outside = wrap_row(vx_outside, 0, S1, 3)   # y
-    vx_outside = wrap_row(vx_outside, 1, S2, 3)   # z
-    Vxn = lax.dynamic_update_slice_in_dim(Vxn, vx_outside[None], S0, axis=0)
+    Vxn = lax.dynamic_update_slice_in_dim(Vxn, vx_out[None], S0, axis=0)
     return Pn, Vxn, Vyn, Vzn
+
+
+def _wrap_row(v, axis, size, ol):
+    """Periodic self-wrap of the outermost rows of a plane along `axis`."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    idx = lax.broadcasted_iota(jnp.int32, v.shape, axis)
+    v = jnp.where(idx == 0, lax.slice_in_dim(v, size - ol, size - ol + 1,
+                                             axis=axis), v)
+    return jnp.where(idx == size - 1,
+                     lax.slice_in_dim(v, ol - 1, ol, axis=axis), v)

@@ -36,14 +36,21 @@ overhang of a `(n+1)`-sized face field is preserved on the slab.
 Semantics vs the sequential composition:
   - fully periodic or interior ranks: identical (the exchanged planes are the
     same arithmetic on the same values);
-  - open-boundary edge ranks: halo planes keep their *pre-compute* values
-    (the reference's no-write semantics — its users' stencils never write
-    halo planes, `/root/reference/test/test_update_halo.jl:727-732`) except
-    the corner/edge cells shared with an exchanged dimension, which carry
-    that dimension's received values (as in the reference, where the later
-    exchange overwrites them); the plain composition instead leaves whatever
-    `compute` put there.  Halo cells at an open boundary are not meaningful
-    in either model.
+  - open-boundary edge ranks: the no-write fallback planes (the reference's
+    semantics — nothing is received there,
+    `/root/reference/test/test_update_halo.jl:727-732`) are taken from the
+    *slab-computed* output, not the pre-compute field, so whatever `compute`
+    writes into its outermost planes is preserved exactly as in the plain
+    composition.  For slice-based stencils (every model in `igg.models`;
+    anything whose outermost-plane values read only cells within the slab)
+    the two formulations are therefore identical *everywhere* — including
+    full-shape updates like the Stokes pressure, whose open-boundary planes
+    evolve.  Only wrap-based computes (e.g. `jnp.roll` stencils), whose edge
+    values depend on the far side of the array, differ on those planes —
+    and for those the plain composition's edge values are block-size
+    artifacts anyway.  The fallback planes stay data-independent of the
+    full-domain `compute` (they come from the same thin slabs as the send
+    planes), so the overlap property is unaffected.
 
 Requirements on `compute`: a shift-invariant local stencil of radius
 `<= ol-1` per participating dimension (it is applied to thin slabs, so it
@@ -128,6 +135,7 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1,
     #    *smaller* than the base (its side-0 plane sits below the base's)
     #    and side-1 reads reaching above a smaller field's overhang.
     sends = [dict() for _ in fields]
+    stales = [dict() for _ in fields]
     for (d, ol) in dims_base:
         dfs_all = [B.shape[d] - s0[d] for B in (*fields, *aux)]
         dgs = [F.shape[d] - s0[d] for F in fields]
@@ -141,6 +149,9 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1,
             else:
                 lo = p - radius + min(0, dmin_all - dgmax)
                 E = (p - lo) + radius + 1 - min(dmin_all, dgmin)
+            # Validate the radius-derived window BEFORE clamping: this is
+            # the overlap-insufficiency diagnostic (the clamped window
+            # always fits by construction).
             for B in (*fields, *aux):
                 df = B.shape[d] - s0[d]
                 if lo < 0 or lo + E + df > B.shape[d]:
@@ -150,6 +161,14 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1,
                         f"an array of local size {B.shape[d]}; increase the "
                         f"grid overlap to accommodate radius {radius} with "
                         f"staggers {sorted(set(dfs_all))}.")
+            # Extend the window to the block end so the outermost plane is
+            # in-slab: it is the open-boundary no-write fallback (see module
+            # docstring) — a few extra rows of O(s²) work.
+            if side == 0:
+                E += lo
+                lo = 0
+            else:
+                E = s0[d] - lo
 
             def cut(B):
                 df = B.shape[d] - s0[d]
@@ -161,10 +180,13 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1,
             for i, out in enumerate(outs):
                 local_p = (p + dgs[i] if side == 0 else p) - lo
                 sends[i][(d, side)] = _plane(out, d, local_p)
+                stales[i][(d, side)] = _plane(
+                    out, d, 0 if side == 0 else out.shape[d] - 1)
 
     # 2. Dimension-sequential plane-level exchange with corner propagation,
     #    per field (shared with the halo engine).
-    recvs = [exchange_all_dims(F, sends[i], per_field_dims[i], grid)
+    recvs = [exchange_all_dims(F, sends[i], per_field_dims[i], grid,
+                               stale=stales[i])
              for i, F in enumerate(fields)]
 
     # 3. Full-domain compute — no data dependency on any ppermute above.

@@ -188,7 +188,7 @@ def test_make_step_pallas_interpret(selfwrap_grid):
     Pe, phi = _fields()
     step = hm3d.make_step(params, use_pallas=True, pallas_interpret=True,
                           donate=False)
-    ref = hm3d.make_step(params, donate=False)
+    ref = hm3d.make_step(params, donate=False, use_pallas=False)
     Pe2, phi2 = step(Pe, phi)
     Pe3, phi3 = ref(Pe, phi)
     for a, b in ((Pe2, Pe3), (phi2, phi3)):

@@ -134,7 +134,8 @@ def test_trapezoid_2d_kernel_matches_window():
     A_ext = jax.jit(extend2)(A)
 
     out = jax.jit(lambda Text, A_ext: _chunk_call(
-        Text, A_ext, T.shape, K=K, bx=bx, y_ext=True, **scal))(Text, A_ext)
+        Text, A_ext, T.shape, K=K, bx=bx, y_ext=True, z_ext=False,
+        **scal))(Text, A_ext)
 
     def window(Text, A_ext):
         def step(_, U):
@@ -146,6 +147,56 @@ def test_trapezoid_2d_kernel_matches_window():
             return U
         U = lax.fori_loop(0, K, step, Text)
         return U[K:K + T.shape[0], K:K + T.shape[1]]
+
+    ref = jax.jit(window)(Text, A_ext)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_trapezoid_3d_kernel_matches_window():
+    """The triply-extended (N,M,K) 3-D-torus chunk kernel against the
+    pure-XLA window dynamics on the same extended buffer (VERDICT round-3
+    item 2; the window-vs-per-step equivalence is pinned on the CPU (2,2,2)
+    torus by tests/test_trapezoid.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_pallas import _u_rows
+    from igg.ops.diffusion_trapezoid import _chunk_call, _extend_dim
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    T = igg.update_halo(T)
+    dx, dy, dz = params.spacing()
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+    A = float(params.timestep() * params.lam) / Cp
+    K = bx = 8
+
+    def extend3(F):
+        F = _extend_dim(F, K, 2, grid, 0)
+        F = _extend_dim(F, K, 2, grid, 1)
+        return _extend_dim(F, K, 2, grid, 2)
+
+    Text = jax.jit(extend3)(T)
+    A_ext = jax.jit(extend3)(A)
+
+    out = jax.jit(lambda Text, A_ext: _chunk_call(
+        Text, A_ext, T.shape, K=K, bx=bx, y_ext=True, z_ext=True,
+        **scal))(Text, A_ext)
+
+    def window(Text, A_ext):
+        def step(_, U):
+            return U.at[1:-1, 1:-1, 1:-1].set(
+                _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1], **scal))
+        U = lax.fori_loop(0, K, step, Text)
+        return U[K:K + T.shape[0], K:K + T.shape[1], K:K + T.shape[2]]
 
     ref = jax.jit(window)(Text, A_ext)
     scale = float(jnp.max(jnp.abs(ref)))

@@ -15,7 +15,8 @@ class TestStokes3D:
         igg.init_global_grid(nx, nx, nx, **PER, quiet=True, **kw)
         params = stokes3d.Params()
         P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float64)
-        it = stokes3d.make_iteration(params, donate=False)
+        it = stokes3d.make_iteration(params, donate=False,
+                                     use_pallas=False)
         for _ in range(nit):
             P, Vx, Vy, Vz = it(P, Vx, Vy, Vz, Rho)
         out = tuple(igg.gather_interior(a) for a in (P, Vx, Vy, Vz))
@@ -33,7 +34,8 @@ class TestStokes3D:
         igg.init_global_grid(8, 8, 8, **PER, quiet=True)
         params = stokes3d.Params()
         P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float64)
-        it = stokes3d.make_iteration(params, donate=False)
+        it = stokes3d.make_iteration(params, donate=False,
+                                     use_pallas=False)
 
         def vz_update_norm(Vz_prev, Vz_next):
             return float(np.max(np.abs(igg.gather_interior(Vz_next)
@@ -62,7 +64,7 @@ class TestHM3D:
         igg.init_global_grid(nx, nx, nx, **PER, quiet=True, **kw)
         params = hm3d.Params()
         Pe, phi = hm3d.init_fields(params, dtype=np.float64)
-        step = hm3d.make_step(params, donate=False)
+        step = hm3d.make_step(params, donate=False, use_pallas=False)
         for _ in range(nt):
             Pe, phi = step(Pe, phi)
         out = tuple(igg.gather_interior(a) for a in (Pe, phi))
@@ -102,7 +104,8 @@ class TestOverlap:
             params = stokes3d.Params()
             P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params,
                                                       dtype=np.float64)
-            it = stokes3d.make_iteration(params, donate=False, overlap=ov)
+            it = stokes3d.make_iteration(params, donate=False, overlap=ov,
+                                         use_pallas=False)
             for _ in range(6):
                 P, Vx, Vy, Vz = it(P, Vx, Vy, Vz, Rho)
             results[tag] = [np.asarray(a) for a in (P, Vx, Vy, Vz)]
@@ -118,7 +121,8 @@ class TestOverlap:
         igg.init_global_grid(8, 8, 8, **PER, quiet=True)  # default ol=2
         params = stokes3d.Params()
         P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float64)
-        it = stokes3d.make_iteration(params, donate=False, overlap=True)
+        it = stokes3d.make_iteration(params, donate=False, overlap=True,
+                                     use_pallas=False)
         with pytest.raises(igg.GridError, match="radius 2 exceeds"):
             it(P, Vx, Vy, Vz, Rho)
 
@@ -129,7 +133,7 @@ class TestOverlap:
             params = hm3d.Params()
             Pe, phi = hm3d.init_fields(params, dtype=np.float64)
             step = hm3d.make_step(params, donate=False, overlap=ov,
-                                  n_inner=2)
+                                  use_pallas=False, n_inner=2)
             for _ in range(3):
                 Pe, phi = step(Pe, phi)
             results[tag] = [np.asarray(a) for a in (Pe, phi)]
