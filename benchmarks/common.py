@@ -46,10 +46,12 @@ def _commit() -> "str | None":
 
 def provenance() -> dict:
     """Commit + timestamp + smoke flag stamped on every result line, so
-    checked-in artifacts are traceable to the code that produced them and
-    CPU-mesh lines can never be mistaken for accelerator evidence
-    (`smoke: true` = virtual-CPU-mesh run: validates program structure,
-    says nothing about TPU/ICI performance)."""
+    checked-in artifacts are traceable to the code that produced them.
+    `smoke: true` (the default on a virtual CPU mesh) marks a quick
+    structural-validation run; a benchmark may override it for a
+    full-quality measured run — the `platform` field inside each record's
+    config still says where it ran, so CPU-mesh lines can never be
+    mistaken for accelerator evidence."""
     import jax
 
     return {
@@ -61,8 +63,8 @@ def provenance() -> dict:
 
 def emit(record: dict, stream=sys.stdout) -> None:
     """One JSON line per result (the contract of the repo's `bench.py`),
-    stamped with provenance."""
-    print(json.dumps({**record, **provenance()}), file=stream)
+    stamped with provenance (record-level keys win, see `provenance`)."""
+    print(json.dumps({**provenance(), **record}), file=stream)
     stream.flush()
 
 

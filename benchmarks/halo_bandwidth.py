@@ -41,6 +41,7 @@ Usage: `python benchmarks/halo_bandwidth.py [n] [nt] [n_inner]`.
 
 from __future__ import annotations
 
+import contextlib
 import sys
 
 import numpy as np
@@ -96,8 +97,16 @@ def main():
 
     import jax.numpy as jnp
 
-    # f16 on CPU (f64 needs jax_enable_x64); bf16 on accelerators.
-    dtypes = (np.float32, np.float16 if platform == "cpu" else jnp.bfloat16)
+    # f16 on CPU; bf16 + f64 on accelerators (f64 = the reference's Julia
+    # default, on the pinned aligned-DUS XLA plan — VERDICT r3 item 4; see
+    # igg/ops/halo_write.py for why the writers' u32 view is TPU-blocked).
+    # x64 is enabled only around the f64 measurement: under a global x64
+    # flag, pallas BlockSpec index maps trace as i64 and Mosaic rejects
+    # them ('func.return (i64, i64)'), breaking the f32/bf16 writer paths.
+    if platform == "cpu":
+        dtypes = (np.float32, np.float16)
+    else:
+        dtypes = (np.float32, jnp.bfloat16, np.float64)
     for halo_dims, periods in (("xyz", (1, 1, 1)), ("xy", (1, 1, 0))):
         igg.init_global_grid(n, n, n, periodx=periods[0], periody=periods[1],
                              periodz=periods[2], quiet=True)
@@ -106,8 +115,12 @@ def main():
              f"local={n}^3 halo_dims={halo_dims} n_inner={n_inner}")
         for nfields in (1, 2, 4):
             for dtype in dtypes:
-                sec, gbps, ndims = bench(n, nfields, dtype, nt=nt,
-                                         n_inner=n_inner)
+                ctx = (jax.enable_x64(True)
+                       if np.dtype(dtype).itemsize == 8
+                       else contextlib.nullcontext())
+                with ctx:
+                    sec, gbps, ndims = bench(n, nfields, dtype, nt=nt,
+                                             n_inner=n_inner)
                 emit({
                     "metric": "halo_exchange_bandwidth_per_chip",
                     "value": round(gbps, 2),

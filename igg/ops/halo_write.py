@@ -58,9 +58,74 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 # Element sizes the writers handle: 32-bit natively; bf16/f16 round-trip
 # through f32 for the lane-dim plane expand (Mosaic: "Insertion of minor dim
 # that is not a no-op only supported for 32-bit types"), which is exact.
-# 64-bit would hit the same Mosaic limitation with no exact round-trip, so
-# those fields take the XLA fallback plans.
-_EXPAND_OK = (2, 4)
+# 64-bit non-complex dtypes (the reference's Julia-default Float64) run the
+# SAME 32-bit kernels on a lane-paired uint32 bitcast view — `(n0,n1,n2)`
+# f64 reinterpreted as `(n0,n1,2*n2)` u32 (a free metadata reshape, exact
+# by construction): each f64 halo lane becomes a pair of u32 lanes, so the
+# lane-dim writes split into word-wise single-lane writes and everything
+# else is untouched geometry (see `_u64_view`/`_u64_specs`).  complex64
+# (the other 8-byte dtype) has no paired view and takes the XLA fallback
+# plans; complex128 (16 bytes) is outside `_EXPAND_OK` entirely.
+#
+# CAVEAT (round 4, pinned by on-chip attempts): current XLA:TPU cannot
+# compile the view — its x64 rewriter lacks 64-bit `bitcast-convert`
+# ("rewriting is not implemented: bitcast-convert u64[...]"), and native
+# f64 pallas_call is rejected by Mosaic — so the engine routes hardware
+# f64 to the deterministic aligned-DUS XLA plan instead
+# (`igg.halo._writer_dims`); the u32 path stays fully tested through the
+# interpret seam, ready for a toolchain that accepts either form.
+_EXPAND_OK = (2, 4, 8)
+
+
+def _is_u64(dtype) -> bool:
+    import numpy as np
+
+    return np.dtype(dtype).itemsize == 8 and np.dtype(dtype).kind != "c"
+
+
+def _u64_view(A):
+    """f64/i64 block `(n0, n1, n2)` -> u32 view `(n0, n1, 2*n2)` (bitcast +
+    trailing-dims merge: metadata only)."""
+    import jax
+    import jax.numpy as jnp
+
+    bits = jax.lax.bitcast_convert_type(A, jnp.uint32)
+    return bits.reshape(A.shape[0], A.shape[1], A.shape[2] * 2)
+
+
+def _u64_unview(B, dtype):
+    import jax
+
+    n0, n1, m = B.shape
+    return jax.lax.bitcast_convert_type(B.reshape(n0, n1, m // 2, 2), dtype)
+
+
+def _u64_specs(specs):
+    """Transform writer specs to the u32 lane-paired view: dim-0/1 planes
+    merge their trailing (lane) axis with the word axis; dim-2 entries
+    become word-pair modes (`ext2`: four single-word planes; `wrap2`:
+    doubled lane positions)."""
+    import jax
+    import jax.numpy as jnp
+
+    def rows(p):
+        bits = jax.lax.bitcast_convert_type(p, jnp.uint32)
+        return bits.reshape(p.shape[0], p.shape[1] * 2)
+
+    out = []
+    for s in specs:
+        d = s[0]
+        if d < 2:
+            out.append((d, s[1], rows(s[2]), rows(s[3])) if s[1] == "ext"
+                       else s)
+        elif s[1] == "ext":
+            fb = jax.lax.bitcast_convert_type(s[2], jnp.uint32)
+            lb = jax.lax.bitcast_convert_type(s[3], jnp.uint32)
+            out.append((2, "ext2", fb[..., 0], fb[..., 1],
+                        lb[..., 0], lb[..., 1]))
+        else:
+            out.append((2, "wrap2", s[2]))
+    return out
 
 
 def _pick_bx(n0: int, n1: int, n2: int, itemsize: int) -> int:
@@ -73,14 +138,26 @@ def _pick_bx(n0: int, n1: int, n2: int, itemsize: int) -> int:
     return bx
 
 
-def halo_write_supported(shape, dtype) -> bool:
-    """The writer handles rank-3 blocks of >= 16-bit elements (16-bit lane
-    expansion round-trips exactly through f32)."""
+def _dtype_ok(dtype, interpret: bool) -> bool:
+    """Shared dtype eligibility: 16/32-bit anywhere; 64-bit non-complex
+    only in interpret mode (the u32 lane-paired view is blocked on real
+    hardware by the XLA:TPU x64 rewriter — see the module caveat; the
+    itemsize-8 complex64 has no paired view at all)."""
     import numpy as np
 
-    if len(shape) != 3:
+    itemsize = np.dtype(dtype).itemsize
+    if itemsize not in _EXPAND_OK:
         return False
-    if np.dtype(dtype).itemsize not in _EXPAND_OK:
+    if itemsize == 8:
+        return interpret and _is_u64(dtype)
+    return True
+
+
+def halo_write_supported(shape, dtype, interpret: bool = False) -> bool:
+    """The writer handles rank-3 blocks of >= 16-bit elements (16-bit lane
+    expansion round-trips exactly through f32; 64-bit non-complex through
+    the lane-paired u32 view, interpret mode only — see module caveat)."""
+    if len(shape) != 3 or not _dtype_ok(dtype, interpret):
         return False
     n0, n1, n2 = shape
     return n0 >= 2 and n1 >= 2 and n2 >= 2
@@ -95,15 +172,15 @@ def _expand_minor(p, dtype):
     return p.astype(jnp.float32)[..., None].astype(dtype)
 
 
-def slab_write_supported(shape, dtype, dims) -> bool:
+def slab_write_supported(shape, dtype, dims, interpret: bool = False) -> bool:
     """Whether the per-dim slab writers cover a halo set (no lane dim):
     rank-3, dim-1 updates need tile-aligned rows with distinct first/last
-    tiles."""
+    tiles; dtype eligibility as in :func:`halo_write_supported`."""
     import numpy as np
 
     if len(shape) != 3 or (len(shape) - 1) in dims:
         return False
-    if np.dtype(dtype).itemsize not in _EXPAND_OK:
+    if not _dtype_ok(dtype, interpret):
         return False
     ts = _sublane_tile(np.dtype(dtype).itemsize)
     if 1 in dims and (shape[1] % ts != 0 or shape[1] < 2 * ts):
@@ -237,13 +314,15 @@ def _write_dim1(A, spec, *, interpret: bool):
         alias=alias, args=args, interpret=interpret)
 
 
-def _write_dim2(A, first, last, *, interpret: bool):
+def _write_dim2(A, zspec, *, interpret: bool):
     """In-place RMW of the two outer lane-dim planes touching ONLY the two
     dirty 128-lane tile columns (`2*128/n2` of the block, vs the one-pass
-    writer's full RMW).  Received dense `(n0, n1)` planes only — self-wrap
-    sources live inside the dirty columns of the OTHER grid step and would
-    need whole-column side reads that erase the saving, so wrap-mode z
-    stays on the one-pass writer."""
+    writer's full RMW).  Received dense planes only — self-wrap sources
+    live inside the dirty columns of the OTHER grid step and would need
+    whole-column side reads that erase the saving, so wrap-mode z stays on
+    the one-pass writer.  `zspec` is `(2, "ext", first, last)` or the
+    u32 lane-paired `(2, "ext2", fe, fo, le, lo)` (two word lanes per
+    64-bit halo lane)."""
     import numpy as np
     from jax import lax
     import jax.numpy as jnp
@@ -252,31 +331,45 @@ def _write_dim2(A, first, last, *, interpret: bool):
     n0, n1, n2 = A.shape
     bx = _pick_bx(n0, n1, 128, np.dtype(A.dtype).itemsize)
     ncols = n2 // 128
+    paired = zspec[1] == "ext2"
+    planes = zspec[2:6] if paired else zspec[2:4]
 
-    def kernel(pf_ref, pq_ref, a_ref, o_ref):
+    def kernel(*refs):
+        *plane_refs, a_ref, o_ref = refs
         j = pl.program_id(1)
         t = a_ref[...]
         idx = lax.broadcasted_iota(jnp.int32, t.shape, 2)
+        if paired:
+            lo_lanes, hi_lanes = ((0, 1), (126, 127))
+        else:
+            lo_lanes, hi_lanes = ((0,), (127,))
+        nlo = len(lo_lanes)
 
         @pl.when(j == 0)
         def _():
-            o_ref[...] = jnp.where(idx == 0,
-                                   _expand_minor(pf_ref[...], t.dtype), t)
+            u = t
+            for lane_i, ref in zip(lo_lanes, plane_refs[:nlo]):
+                u = jnp.where(idx == lane_i,
+                              _expand_minor(ref[...], t.dtype), u)
+            o_ref[...] = u
 
         @pl.when(j == 1)
         def _():
-            o_ref[...] = jnp.where(idx == 127,
-                                   _expand_minor(pq_ref[...], t.dtype), t)
+            u = t
+            for lane_i, ref in zip(hi_lanes, plane_refs[nlo:]):
+                u = jnp.where(idx == lane_i,
+                              _expand_minor(ref[...], t.dtype), u)
+            o_ref[...] = u
 
+    nplanes = len(planes)
     return _inplace_call(
         kernel, A, grid=(n0 // bx, 2),
-        in_specs=[pl.BlockSpec((bx, n1), lambda i, j: (i, 0)),
-                  pl.BlockSpec((bx, n1), lambda i, j: (i, 0)),
-                  pl.BlockSpec((bx, n1, 128),
-                               lambda i, j: (i, 0, j * (ncols - 1)))],
+        in_specs=[pl.BlockSpec((bx, n1), lambda i, j: (i, 0))] * nplanes
+        + [pl.BlockSpec((bx, n1, 128),
+                        lambda i, j: (i, 0, j * (ncols - 1)))],
         out_spec=pl.BlockSpec((bx, n1, 128),
                               lambda i, j: (i, 0, j * (ncols - 1))),
-        alias=2, args=(first, last), interpret=interpret)
+        alias=nplanes, args=tuple(planes), interpret=interpret)
 
 
 def lane_columns_writable(shape, dtype, dims, wraps) -> bool:
@@ -298,16 +391,26 @@ def write_lane_active(A, specs, wraps, *, interpret: bool = False):
     (slab writers for dims 0/1, then `_write_dim2` RMWing only the two
     dirty lane columns) when the lane halo is exchanged and spans >2 tile
     columns, the one-pass writer otherwise.  Shared by the halo engine and
-    `assemble_field` (hide_communication)."""
+    `assemble_field` (hide_communication).  64-bit fields run on the u32
+    lane-paired view (module docstring)."""
+    if _is_u64(A.dtype):
+        B = _write_lane_active_raw(_u64_view(A), _u64_specs(specs), wraps,
+                                   interpret=interpret)
+        return _u64_unview(B, A.dtype)
+    return _write_lane_active_raw(A, specs, wraps, interpret=interpret)
+
+
+def _write_lane_active_raw(A, specs, wraps, *, interpret: bool = False):
     lane = A.ndim - 1
     zspec = [sp for sp in specs if sp[0] == lane]
     dims = [sp[0] for sp in specs]
-    if (zspec and zspec[0][1] == "ext"
+    if (zspec and zspec[0][1] in ("ext", "ext2")
             and lane_columns_writable(A.shape, A.dtype, dims, wraps)):
         rest = [sp for sp in specs if sp[0] != lane]
-        B = halo_write_slabs(A, rest, interpret=interpret) if rest else A
-        return _write_dim2(B, zspec[0][2], zspec[0][3], interpret=interpret)
-    return halo_write(A, specs, interpret=interpret)
+        B = (_halo_write_slabs_raw(A, rest, interpret=interpret)
+             if rest else A)
+        return _write_dim2(B, zspec[0], interpret=interpret)
+    return _halo_write_raw(A, specs, interpret=interpret)
 
 
 def halo_write_slabs(A, specs: Sequence[Tuple], *, interpret: bool = False):
@@ -315,7 +418,17 @@ def halo_write_slabs(A, specs: Sequence[Tuple], *, interpret: bool = False):
     dimension order (later dims win corners).  Touches only the dirty
     boundary slabs (~20-30 us at 256^3 vs a 200 us full pass), with cost
     strictly linear in the number of fields.  Dim-0 wrap sources must be
-    passed as lazy "ext" slices (they cross grid blocks)."""
+    passed as lazy "ext" slices (they cross grid blocks).  64-bit fields
+    run on the u32 lane-paired view (module docstring)."""
+    if _is_u64(A.dtype):
+        B = _halo_write_slabs_raw(_u64_view(A), _u64_specs(specs),
+                                  interpret=interpret)
+        return _u64_unview(B, A.dtype)
+    return _halo_write_slabs_raw(A, specs, interpret=interpret)
+
+
+def _halo_write_slabs_raw(A, specs: Sequence[Tuple], *,
+                          interpret: bool = False):
     for s in specs:
         d = s[0]
         if d == 0:
@@ -336,8 +449,17 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
 
     `specs` is a list of `(dim, mode, ...)` entries in increasing dim order:
     `(d, "ext", first, last)` with dense 2-D planes (the squeezed plane
-    shape of dim `d`), or `(d, "wrap", ol)` for `d >= 1`.
+    shape of dim `d`), or `(d, "wrap", ol)` for `d >= 1`.  64-bit
+    non-complex fields run on the u32 lane-paired view (module docstring).
     """
+    if _is_u64(A.dtype):
+        B = _halo_write_raw(_u64_view(A), _u64_specs(specs),
+                            interpret=interpret)
+        return _u64_unview(B, A.dtype)
+    return _halo_write_raw(A, specs, interpret=interpret)
+
+
+def _halo_write_raw(A, specs: Sequence[Tuple], *, interpret: bool = False):
     import numpy as np
     from jax import lax
     import jax.numpy as jnp
@@ -351,6 +473,8 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
     for s in specs:
         if s[1] == "ext":
             ext_planes += [s[2], s[3]]
+        elif s[1] == "ext2":
+            ext_planes += list(s[2:6])
         elif s[0] == 0:
             raise ValueError("dim-0 wrap sources cross grid blocks; pass "
                              "them as lazy 'ext' slices")
@@ -378,6 +502,25 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
                     t = jnp.where(idx == 0, _expand_minor(pf, t.dtype), t)
                     t = jnp.where(idx == n2 - 1, _expand_minor(pq, t.dtype),
                                   t)
+            elif s[1] == "ext2":
+                # u32 lane-paired view: each 64-bit halo lane is two
+                # word lanes, written from four single-word planes.
+                idx = lax.broadcasted_iota(jnp.int32, t.shape, 2)
+                for lane_i, ref_j in ((0, k), (1, k + 1),
+                                      (n2 - 2, k + 2), (n2 - 1, k + 3)):
+                    t = jnp.where(idx == lane_i,
+                                  _expand_minor(plane_refs[ref_j][...],
+                                                t.dtype), t)
+                k += 4
+            elif s[1] == "wrap2":
+                # u32 lane-paired self-wrap: 64-bit source lane n2-ol
+                # (resp. ol-1) is word-lane pair 2*(n2-ol) (resp. 2ol-2).
+                ol = s[2]
+                idx = lax.broadcasted_iota(jnp.int32, t.shape, 2)
+                for lane_i, src in ((0, n2 - 2 * ol), (1, n2 - 2 * ol + 1),
+                                    (n2 - 2, 2 * ol - 2),
+                                    (n2 - 1, 2 * ol - 1)):
+                    t = jnp.where(idx == lane_i, t[:, :, src:src + 1], t)
             else:
                 ol = s[2]
                 if d == 1:
@@ -392,7 +535,7 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
 
     in_specs = []
     for s in specs:
-        if s[1] != "ext":
+        if s[1] not in ("ext", "ext2"):
             continue
         d = s[0]
         if d == 0:
@@ -401,7 +544,7 @@ def halo_write(A, specs: Sequence[Tuple], *, interpret: bool = False):
             bs = pl.BlockSpec((bx, n2), lambda i: (i, 0))
         else:
             bs = pl.BlockSpec((bx, n1), lambda i: (i, 0))
-        in_specs += [bs, bs]
+        in_specs += [bs] * (2 if s[1] == "ext" else 4)
     in_specs.append(pl.BlockSpec((bx, n1, n2), lambda i: (i, 0, 0)))
 
     return _inplace_call(

@@ -23,10 +23,14 @@ from typing import List, Sequence, Tuple
 _BLOCK_BYTES = 4 * 1024 * 1024
 
 
-def pack_planes_supported(shape) -> bool:
+def pack_planes_supported(shape, dtype) -> bool:
     import numpy as np
 
     if len(shape) != 3:
+        return False
+    if np.dtype(dtype).itemsize > 4:
+        # The in-kernel lane extraction is 32-bit territory in Mosaic;
+        # 64-bit planes stay lazy XLA slices.
         return False
     s0, s1, s2 = shape
     return s0 >= 1 and s1 * s2 * 4 <= _BLOCK_BYTES

@@ -290,7 +290,7 @@ class TestHaloWriter:
         (None, None, "wrap"),
         ("ext", None, "wrap"),
     ])
-    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16", np.float64])
     def test_against_oracle(self, modes, dtype):
         import jax.numpy as jnp
         from igg.ops.halo_write import halo_write
@@ -336,7 +336,7 @@ class TestSlabWriters:
         ("ext", None), ("ext", "ext"), ("ext", "wrap"),
         (None, "ext"), (None, "wrap"),
     ])
-    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16", np.float64])
     def test_against_oracle(self, modes, dtype):
         import jax.numpy as jnp
         from igg.ops.halo_write import _sublane_tile, halo_write_slabs
@@ -401,6 +401,20 @@ class TestWriterEngineIntegration:
         out, exp = roundtrip((8, 16, 256), dtype=np.float32)
         np.testing.assert_array_equal(out, exp.astype(np.float32))
 
+    def test_lane_active_roundtrip_float64(self):
+        """VERDICT round-3 item 4: the Julia-default Float64 runs the
+        deterministic writer path (u32 lane-paired view), not the XLA
+        compile-lottery plans."""
+        igg.init_global_grid(8, 16, 256, dimx=2, dimy=2, dimz=2,
+                             **PERIODIC, quiet=True)
+        from igg.halo import _writer_dims, active_dims, moving_dims
+        g = igg.get_global_grid()
+        dd = moving_dims(active_dims((8, 16, 256), g), g)
+        assert _writer_dims(igg.zeros((8, 16, 256), dtype=np.float64),
+                            dd, g)[1], "writer gate must be on for f64"
+        out, exp = roundtrip((8, 16, 256), dtype=np.float64)
+        np.testing.assert_array_equal(out, exp.astype(np.float64))
+
     # Non-lane sets -> slab writers.
     @pytest.mark.parametrize("dims,periods", [
         ((2, 4, 1), (1, 1, 0)),   # x/y exchanged, z inactive
@@ -420,7 +434,7 @@ class TestLaneColumnWriter:
     """Dirty-column lane writer (_write_dim2): exchanged z halos spanning
     >2 tile columns RMW only the two dirty columns."""
 
-    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+    @pytest.mark.parametrize("dtype", [np.float32, "bfloat16", np.float64])
     def test_unit_oracle(self, dtype):
         import jax.numpy as jnp
         from igg.ops.halo_write import _write_dim2
@@ -431,7 +445,7 @@ class TestLaneColumnWriter:
         A = jnp.asarray(rng.integers(0, 63, (8, 10, 384)), dtype=dtype)
         pf = jnp.asarray(rng.integers(0, 63, (8, 10)), dtype=dtype)
         pq = jnp.asarray(rng.integers(0, 63, (8, 10)), dtype=dtype)
-        out = _write_dim2(A, pf, pq, interpret=True)
+        out = _write_dim2(A, (2, "ext", pf, pq), interpret=True)
         exp = np.array(A, dtype=np.float64)
         exp[:, :, 0] = np.asarray(pf, dtype=np.float64)
         exp[:, :, -1] = np.asarray(pq, dtype=np.float64)
