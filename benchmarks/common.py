@@ -63,7 +63,13 @@ def provenance() -> dict:
 
 def emit(record: dict, stream=sys.stdout) -> None:
     """One JSON line per result (the contract of the repo's `bench.py`),
-    stamped with provenance (record-level keys win, see `provenance`)."""
+    stamped with provenance (record-level keys win, see `provenance`).
+    Multi-controller launches (one process per pod host): only process 0
+    emits, so per-host stdout collection yields one row per measurement."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
     print(json.dumps({**provenance(), **record}), file=stream)
     stream.flush()
 

@@ -1,0 +1,116 @@
+"""Pod runbook — ONE script that, pointed at a real TPU slice, reproduces
+the BASELINE multi-chip configs and emits the repo's standard JSON-line
+schema; dry-runnable end-to-end on the virtual 8-device CPU mesh (wired
+into `run_all.py --quick`, hence `ci.sh`), so when multi-chip hardware
+appears there is no round-1-style scramble — the launch recipe is this
+file.
+
+Covered configs (BASELINE.json):
+  2. 3-D heat diffusion 256³/chip on the slice's mesh (update_halo over
+     ICI) — weak-scaling curve over 1..N devices + the full-mesh point.
+  4. HM3D (hydro-mechanical porous flow) weak scaling, the
+     `hide_communication` workload.
+  5. Stokes solver with comm/compute overlap on the full mesh
+     (plain / hidden / fused-kernel variants via `overlap_study`).
+Plus the per-chip halo-exchange bandwidth on the full mesh (the
+BASELINE.json headline metric).
+
+Launch on a pod: one controller process per host, all running
+
+    python benchmarks/pod_run.py [--local N] [--nt T] [--n-inner K] [--full]
+
+`igg.init_global_grid` calls `jax.distributed.initialize` itself when the
+cluster env is configured (see `docs/multihost.md` for the per-scheduler
+recipes); only process 0 emits.  On a single-controller environment (one
+host, N chips — or the virtual CPU mesh) it just runs.
+
+Artifacts: stdout JSON lines, one per measurement, in the exact schema of
+`weak_scaling.py` / `overlap_study.py` / `halo_bandwidth.py`; redirect to
+`benchmarks/results/pod_run.jsonl` on a real slice (run_all handles this).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import emit, note
+from weak_scaling import weak_curve
+
+
+def main():
+    import jax
+
+    args = sys.argv[1:]
+
+    def opt(name, default):
+        return int(args[args.index(name) + 1]) if name in args else default
+
+    full = "--full" in args
+    platform = jax.devices()[0].platform
+    on_chip = platform != "cpu"
+    n = opt("--local", 256 if on_chip else 16)
+    nt = opt("--nt", 6 if on_chip else 2)
+    n_inner = opt("--n-inner", 50 if on_chip else 3)
+    ndev = len(jax.devices())
+    note(f"pod_run platform={platform} devices={ndev} local={n}^3 nt={nt} "
+         f"n_inner={n_inner} full={full}")
+
+    # Config 2: diffusion weak scaling at local n^3/chip over the mesh.
+    from igg.models import diffusion3d as d3
+
+    note("config 2: diffusion3d weak scaling (XLA path — decomposition-"
+         "portable baseline)")
+    weak_curve(lambda *a, **kw: d3.run(*a, use_pallas=False, **kw),
+               "diffusion3d", n, nt=nt, n_inner=n_inner, full=full)
+    if on_chip:
+        note("config 2b: diffusion3d weak scaling (fused-kernel tier)")
+        weak_curve(lambda *a, **kw: d3.run(*a, use_pallas="auto", **kw),
+                   "diffusion3d_pallas", n, nt=nt, n_inner=n_inner,
+                   full=full)
+
+    # Config 4: HM3D weak scaling — the hide_communication workload (the
+    # reference's published parallel-efficiency figure is the HM3D app,
+    # `/root/reference/README.md:5-7`).
+    from igg.models import hm3d
+
+    note("config 4: hm3d weak scaling (overlap=True workload)")
+    weak_curve(lambda *a, **kw: hm3d.run(*a, use_pallas=False, **kw),
+               "hm3d_hidden", n, nt=nt, n_inner=n_inner, full=full,
+               run_kwargs=dict(overlap=True))
+
+    # Config 5: Stokes comm/compute overlap study on the FULL mesh
+    # (plain / hidden / fused variants; overlap-3 grid).
+    note("config 5: stokes3d overlap study on the full mesh")
+    from overlap_study import study_stokes
+
+    study_stokes(max(n // 2, 16) if on_chip else n, nt, n_inner, platform)
+
+    # Headline metric: per-chip halo-exchange bandwidth on the full mesh.
+    note("headline: halo-exchange bandwidth on the full mesh")
+    import igg
+    from halo_bandwidth import bench
+
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+    for nfields in (1, 4):
+        sec, gbps, ndims = bench(n, nfields, np.float32, nt=nt,
+                                 n_inner=n_inner)
+        emit({
+            "metric": "halo_exchange_bandwidth_per_chip",
+            "value": round(gbps, 2),
+            "unit": "GB/s",
+            "config": {"local": n, "fields": nfields, "dtype": "float32",
+                       "halo_dims": "xyz", "ndims": ndims,
+                       "devices": grid.nprocs, "dims": list(grid.dims),
+                       "platform": platform},
+            "us_per_update": round(sec * 1e6, 2),
+        })
+    igg.finalize_global_grid()
+    note("pod_run complete")
+
+
+if __name__ == "__main__":
+    main()

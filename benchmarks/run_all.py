@@ -44,8 +44,11 @@ def run(script: str, args, *, virtual: int = 0, tag: str,
 
 def main():
     """One invocation refreshes every artifact under `results/`, each line
-    stamped with commit + timestamp and `smoke: true` on CPU-mesh runs
-    (virtual meshes validate program structure, not TPU/ICI performance)."""
+    stamped with commit + timestamp and a `smoke` flag: true by default on
+    CPU-mesh runs (virtual meshes validate program structure, not TPU/ICI
+    performance); a benchmark invoked with `--full` (e.g. weak_scaling
+    below) overrides it to false for full-quality median-of-3 measurements
+    — the row's `config.platform` still records where it ran."""
     quick = "--quick" in sys.argv
     # --quick is the CI/smoke mode: small configs, artifacts land in the
     # gitignored results_smoke/ so committed accelerator evidence is never
@@ -80,7 +83,12 @@ def main():
     # read shared-core numbers).
     r("halo_bandwidth.py", [32, 2, 5], virtual=8, tag="halo_bandwidth_mesh8")
     r("overlap_study.py", [32, 2, 5], virtual=8, tag="overlap_study_mesh8")
-    r("weak_scaling.py", [64, 3, 5], virtual=8, tag="weak_scaling_mesh8")
+    r("weak_scaling.py", [64, 3, 5, "--full"], virtual=8,
+      tag="weak_scaling_mesh8")
+    # The pod runbook (BASELINE configs 2/4/5 in one script), dry-run on the
+    # virtual mesh so the real-slice launch path stays exercised.
+    r("pod_run.py", ["--local", 16, "--nt", 2, "--n-inner", 3], virtual=8,
+      tag="pod_run_mesh8")
 
 
 if __name__ == "__main__":

@@ -49,24 +49,76 @@ _CAUSE = (
     "rounds cost only collective_us); absent on real multi-chip hardware")
 
 
-def run_once(devices, n: int, *, nt: int, n_inner: int, reps: int):
+def run_once(model_run, devices, n: int, *, nt: int, n_inner: int,
+             reps: int, grid_kwargs=None, run_kwargs=None):
     import igg
-    from igg.models import diffusion3d as d3
 
     def one():
         if igg.grid_is_initialized():
             igg.finalize_global_grid()
         igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
-                             quiet=True, devices=devices)
-        _, sec = d3.run(nt, dtype=np.float32, n_inner=n_inner,
-                        use_pallas=False)
+                             quiet=True, devices=devices,
+                             **(grid_kwargs or {}))
+        _, sec = model_run(nt, dtype=np.float32, n_inner=n_inner,
+                           **(run_kwargs or {}))
         return sec
 
     sec = median_of(one, reps=reps)
-    import igg as _igg
-    dims = tuple(_igg.get_global_grid().dims)
-    _igg.finalize_global_grid()
+    dims = tuple(igg.get_global_grid().dims)
+    igg.finalize_global_grid()
     return sec, dims
+
+
+def device_counts(ndev: int):
+    """The measurement ladder 1,2,4,... plus the full mesh (always the last
+    point — the configuration a pod runbook exists to capture)."""
+    counts = [k for k in (1, 2, 4, 8, 16, 32, 64, 128, 256) if k <= ndev]
+    if counts[-1] != ndev:
+        counts.append(ndev)
+    return counts
+
+
+def weak_curve(model_run, model_name: str, n: int, *, nt: int, n_inner: int,
+               full: bool, grid_kwargs=None, run_kwargs=None):
+    """Weak-scaling curve for one model family over growing device counts —
+    the single implementation behind `weak_scaling.py` and
+    `benchmarks/pod_run.py`.  Emits one row per count in the schema
+    documented in the module docstring (plus `config.model`)."""
+    import os
+
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    cores = os.cpu_count() or 1
+    t1 = None
+    for k in device_counts(len(devices)):
+        sec, dims = run_once(model_run, devices[:k], n, nt=nt,
+                             n_inner=n_inner, reps=3 if full else 1,
+                             grid_kwargs=grid_kwargs, run_kwargs=run_kwargs)
+        coll = collective_us(devices[:k]) if platform == "cpu" else None
+        if t1 is None:
+            t1 = sec
+        rec = {
+            "metric": "weak_scaling_efficiency",
+            "value": round(t1 / sec, 4),
+            "unit": "fraction",
+            "config": {"model": model_name, "local": n, "devices": k,
+                       "dims": list(dims),
+                       "exchanged_dims": sum(1 for d in dims if d > 1),
+                       "platform": platform},
+            "ms_per_step": round(sec * 1e3, 4),
+        }
+        if full:
+            rec["smoke"] = False
+        if platform == "cpu":
+            model = t1 * k / min(k, cores)
+            rec["host_cores"] = cores
+            rec["shared_core_model_ms"] = round(model * 1e3, 4)
+            rec["collective_us"] = round(coll, 1)
+            if sec > 1.5 * model:
+                rec["cause"] = _CAUSE
+        emit(rec)
 
 
 def collective_us(devices, chain: int = 6, iters: int = 50) -> float:
@@ -112,39 +164,15 @@ def main():
     nt = int(args[1]) if len(args) > 1 else 3
     n_inner = int(args[2]) if len(args) > 2 else (20 if platform != "cpu" else 5)
 
-    devices = jax.devices()
-    counts = [k for k in (1, 2, 4, 8, 16, 32, 64) if k <= len(devices)]
     cores = os.cpu_count() or 1
-    note(f"platform={platform} available={len(devices)} local={n}^3 "
-         f"counts={counts} host_cores={cores} full={full}")
+    note(f"platform={platform} available={len(jax.devices())} local={n}^3 "
+         f"counts={device_counts(len(jax.devices()))} host_cores={cores} "
+         f"full={full}")
 
-    t1 = None
-    for k in counts:
-        sec, dims = run_once(devices[:k], n, nt=nt, n_inner=n_inner,
-                             reps=3 if full else 1)
-        coll = collective_us(devices[:k]) if platform == "cpu" else None
-        if t1 is None:
-            t1 = sec
-        eff = t1 / sec
-        rec = {
-            "metric": "weak_scaling_efficiency",
-            "value": round(eff, 4),
-            "unit": "fraction",
-            "config": {"local": n, "devices": k, "dims": list(dims),
-                       "exchanged_dims": sum(1 for d in dims if d > 1),
-                       "platform": platform},
-            "ms_per_step": round(sec * 1e3, 4),
-        }
-        if full:
-            rec["smoke"] = False
-        if platform == "cpu":
-            model = t1 * k / min(k, cores)
-            rec["host_cores"] = cores
-            rec["shared_core_model_ms"] = round(model * 1e3, 4)
-            rec["collective_us"] = round(coll, 1)
-            if sec > 1.5 * model:
-                rec["cause"] = _CAUSE
-        emit(rec)
+    from igg.models import diffusion3d as d3
+
+    weak_curve(lambda *a, **kw: d3.run(*a, use_pallas=False, **kw),
+               "diffusion3d", n, nt=nt, n_inner=n_inner, full=full)
 
 
 if __name__ == "__main__":

@@ -15,7 +15,11 @@ import igg
 
 
 def encoded_block(coords, lshape, d=1.0):
-    """Local block filled with z_g*100 + y_g*10 + x_g for grid `coords`."""
+    """Local block filled with z_g*100 + y_g*10 + x_g for grid `coords`;
+    trailing (unsharded) dims beyond the third — e.g. the component axis of
+    a rank-4 `(nx,ny,nz,C)` field — add `1000*index` per trailing dim, so
+    overlapping cells of neighboring blocks still carry identical values
+    component by component."""
     probe = np.empty(lshape)  # carries local shape/ndim for the *_g tools
     nd = len(lshape)
     xs = np.array([igg.x_g(i, d, probe, coords) for i in range(lshape[0])])
@@ -26,6 +30,10 @@ def encoded_block(coords, lshape, d=1.0):
     if nd >= 3:
         zs = np.array([igg.z_g(i, d, probe, coords) for i in range(lshape[2])])
         out = out[:, :, None] + 100.0 * zs[None, None, :]
+    for extra in range(3, nd):
+        out = (out[..., None]
+               + 1000.0 * np.arange(lshape[extra]).reshape(
+                   (1,) * extra + (lshape[extra],)))
     return out
 
 

@@ -165,6 +165,71 @@ class TestSequentialEquivalence:
         self._check((6, 6, 6))
 
 
+class TestEndToEnd4D:
+    """Rank-4 component-stacked fields `(nx, ny, nz, C)` (VERDICT r3 item
+    6): trailing dims are unsharded, planes carry the component axis —
+    the analog of the reference's rank-generic `GGArray{T,N}`
+    (`/root/reference/src/shared.jl:32`)."""
+
+    def test_periodic_multidevice(self):
+        igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
+        out, exp = roundtrip((6, 6, 6, 3))
+        np.testing.assert_array_equal(out, exp)
+
+    def test_open_boundaries(self):
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        out, exp = roundtrip((6, 6, 6, 3))
+        np.testing.assert_array_equal(out, exp)
+
+    def test_staggered_rank4(self):
+        igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
+        out, exp = roundtrip((7, 6, 6, 2))   # x-staggered component field
+        np.testing.assert_array_equal(out, exp)
+
+    def test_grouped_mixed_rank(self):
+        """One grouped update mixing a rank-3 and a rank-4 field (the
+        engine groups same-plane-shape fields for the wire; mixed ranks
+        must exchange independently but correctly in one program)."""
+        import jax
+        from helpers import (encoded_field, expected_after_update,
+                             zero_halo_blocks)
+
+        igg.init_global_grid(6, 6, 6, periody=1, quiet=True)
+        shapes = [(6, 6, 6), (6, 6, 6, 3)]
+        fields, backs, zeroed = [], [], []
+        for ls in shapes:
+            f = encoded_field(ls)
+            b = np.array(f)
+            z = zero_halo_blocks(b, ls)
+            fields.append(jax.device_put(z, igg.sharding_for(len(ls))))
+            backs.append(b)
+            zeroed.append(z)
+        outs = igg.update_halo(*fields)
+        for out, b, z, ls in zip(outs, backs, zeroed, shapes):
+            np.testing.assert_array_equal(
+                np.array(out), expected_after_update(b, z, ls))
+
+    def test_rank4_inside_sharded(self):
+        """update_halo_local on a rank-4 field inside `igg.sharded` — the
+        SPMD path a user's component-stacked solver runs."""
+        import jax
+        from helpers import (encoded_field, expected_after_update,
+                             zero_halo_blocks)
+
+        igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
+
+        @igg.sharded
+        def step(A):
+            return igg.update_halo_local(A)
+
+        ls = (6, 6, 6, 2)
+        f = encoded_field(ls)
+        b = np.array(f)
+        z = zero_halo_blocks(b, ls)
+        out = np.array(step(jax.device_put(z, igg.sharding_for(4))))
+        np.testing.assert_array_equal(out, expected_after_update(b, z, ls))
+
+
 class TestEndToEnd2D1D:
     def test_2d(self):
         igg.init_global_grid(6, 6, 1, periodx=1, quiet=True)  # dims (4,2,1)
