@@ -1,0 +1,153 @@
+"""Comm/compute overlap evidence from the compiled 8-chip schedule
+(VERDICT r3 item 9).
+
+On one chip there is no collective to overlap, so `hide_communication`'s
+value cannot be *measured* here — but it can be PROVEN from the compiler's
+own output: this script AOT-compiles the real `igg.hide_communication`
+diffusion step for a virtual v5e 2x2x2 topology (the chipless TPU
+compiler needs no chips) and parses the optimized HLO's linear schedule,
+where XLA:TPU's latency-hiding scheduler has already placed every op.
+The evidence extracted per `collective-permute` channel:
+
+  - every ppermute is lowered ASYNC (`collective-permute-start` /
+    `-done` pairs);
+  - the starts are issued before the full-domain stencil fusion and the
+    dones land after it, so the ICI transfers are in flight across the
+    main compute;
+  - the overlap fraction = (compute cycles scheduled while >=1 permute
+    is in flight) / (total compute cycles), from the backend's own
+    `estimated_cycles` cost model.
+
+This pins that the `hide_communication` restructuring delivers what it
+promises — the exchange is data-independent of the main compute and the
+scheduler exploits it — independent of pod access.  (The measured
+one-chip `overlap_study` numbers show the restructuring's *cost* — slab
+recompute with nothing to hide; this artifact shows the *benefit* side
+the moment collectives exist.)
+
+Usage: `python benchmarks/overlap_schedule.py [n]` (local grid size per
+chip, default 256).  Requires a TPU-capable compiler (skips cleanly with
+a note on CPU-only hosts).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+import numpy as np
+
+from common import emit, note
+
+
+def compile_overlap_step(n: int):
+    """AOT-compile the hide_communication diffusion step for a virtual
+    (2,2,2) v5e mesh; returns the optimized HLO text."""
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding
+
+    import igg
+    from igg.models import diffusion3d as d3
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x4")
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True, devices=list(topo.devices))
+    grid = igg.get_global_grid()
+    assert tuple(grid.dims) == (2, 2, 2), grid.dims
+
+    params = d3.Params()
+    dx, dy, dz = params.spacing()
+    dt = params.timestep()
+    kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam)
+
+    def local(T, Cp):
+        return d3.local_step(T, Cp, **kw, overlap=True)
+
+    spec = igg.spec_for(3)
+    fn = jax.jit(jax.shard_map(local, mesh=grid.mesh,
+                               in_specs=(spec, spec), out_specs=spec))
+    sh = NamedSharding(grid.mesh, spec)
+    arg = jax.ShapeDtypeStruct((2 * n, 2 * n, 2 * n), np.float32,
+                               sharding=sh)
+    txt = fn.lower(arg, arg).compile().as_text()
+    igg.finalize_global_grid()
+    return txt
+
+
+def analyze_schedule(txt: str) -> dict:
+    """Walk the scheduled entry computation: track which async
+    collective-permutes are in flight at each fusion, summing the backend
+    cost model's `estimated_cycles`."""
+    cyc = re.compile(r'"estimated_cycles":"(\d+)"')
+    start = re.compile(r"%(collective-permute-start[\w.]*) = ")
+    done = re.compile(r"collective-permute-done\(%(collective-permute-start"
+                      r"[\w.]*)\)")
+
+    in_flight: set = set()
+    total = overlapped = 0
+    n_starts = n_dones = 0
+    per_channel: dict = {}
+    main_fusion_overlapped = None
+    biggest = 0
+    for line in txt.splitlines():
+        ms = start.search(line)
+        if ms and "collective-permute-start" in line.split("=")[0]:
+            in_flight.add(ms.group(1))
+            per_channel[ms.group(1)] = 0
+            n_starts += 1
+            continue
+        md = done.search(line)
+        if md:
+            in_flight.discard(md.group(1))
+            n_dones += 1
+            continue
+        mc = cyc.search(line)
+        if mc and " fusion(" in line or (mc and "_fusion" in line):
+            c = int(mc.group(1))
+            total += c
+            if in_flight:
+                overlapped += c
+                for ch in in_flight:
+                    per_channel[ch] += c
+            if c > biggest:
+                biggest = c
+                main_fusion_overlapped = bool(in_flight)
+    return {
+        "starts": n_starts,
+        "dones": n_dones,
+        "total_fusion_cycles": total,
+        "overlapped_fusion_cycles": overlapped,
+        "overlap_fraction": round(overlapped / max(total, 1), 4),
+        "main_stencil_fusion_overlapped": main_fusion_overlapped,
+        "min_cycles_in_flight_per_channel": min(per_channel.values())
+        if per_channel else 0,
+    }
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    try:
+        txt = compile_overlap_step(n)
+    except Exception as e:  # no TPU compiler available (CPU-only host)
+        note(f"overlap_schedule: TPU AOT compile unavailable "
+             f"({type(e).__name__}: {str(e)[:120]}); skipping")
+        return
+    stats = analyze_schedule(txt)
+    note(f"overlap_schedule: {stats['starts']} async permutes, "
+         f"overlap fraction {stats['overlap_fraction']}")
+    emit({
+        "metric": "overlap_schedule_fraction",
+        "value": stats["overlap_fraction"],
+        "unit": "fraction of compute cycles with >=1 permute in flight",
+        "config": {"local": n, "devices": 8, "dims": [2, 2, 2],
+                   "topology": "v5e:2x4 (virtual, AOT)",
+                   "program": "diffusion3d hide_communication step"},
+        **{k: v for k, v in stats.items() if k != "overlap_fraction"},
+        "smoke": False,
+    })
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,10 @@ def main():
     r("overlap_study.py", [] if not quick else [64, 2, 10], tag="overlap_study")
     r("pallas_sweep.py", [] if not quick else [64, 2, 5], tag="pallas_sweep")
     r("gather_retile.py", [] if not quick else [64, 3], tag="gather_retile")
+    # Compiled-schedule overlap evidence (AOT, chipless TPU compiler; skips
+    # with a note where no TPU toolchain exists).
+    r("overlap_schedule.py", [] if not quick else [64],
+      tag="overlap_schedule")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).  64^3 for
     # weak scaling = compute-dominated (see benchmarks/README.md for how to

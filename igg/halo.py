@@ -506,6 +506,15 @@ def _is_tpu(grid) -> bool:
 
 _ASSEMBLY_MODES = (None, "xla", "pallas")
 
+_PALLAS_NEEDS_TPU = (
+    "assembly='pallas' requires TPU devices (the writers are TPU kernels); "
+    "use the default or 'xla' elsewhere.")
+_PALLAS_UNSUPPORTED = (
+    "assembly='pallas' was forced but the Pallas writers do not support "
+    "this field (rank-3 blocks of 16/32-bit elements with tile-compatible "
+    "shapes; 64-bit dtypes are toolchain-blocked on TPU — see "
+    "igg/ops/halo_write.py); use the default or 'xla'.")
+
 
 def _check_assembly(assembly):
     if assembly not in _ASSEMBLY_MODES:
@@ -531,9 +540,13 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
 
     _check_assembly(assembly)
     if assembly == "xla" or not (_is_tpu(grid) or _FORCE_WRITER_INTERPRET):
+        if assembly == "pallas":
+            raise GridError(_PALLAS_NEEDS_TPU)
         return assemble_planes(out, recv, dims_active)
     _, use_writer = _writer_dims(out, dims_active, grid)
     if not use_writer:
+        if assembly == "pallas":
+            raise GridError(_PALLAS_UNSUPPORTED)
         return assemble_planes(out, recv, dims_active)
     specs = [(d, "ext", jnp.squeeze(recv[d][0], d),
               jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
@@ -595,6 +608,8 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
 
     _check_assembly(assembly)
     on_tpu = _is_tpu(grid)
+    if assembly == "pallas" and not (on_tpu or _FORCE_WRITER_INTERPRET):
+        raise GridError(_PALLAS_NEEDS_TPU)
     shapes, sends, dims_moving, wraps, writer = [], [], [], [], []
     for A in fields:
         s = A.shape
@@ -603,6 +618,8 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
                          if (on_tpu or _FORCE_WRITER_INTERPRET)
                          and assembly != "xla"
                          else (frozenset(), False))
+        if assembly == "pallas" and dims and not use_writer:
+            raise GridError(_PALLAS_UNSUPPORTED)
         # Send planes are needed for exchanged dims always, and for wrap
         # dims only on the XLA path: the exchange never reads a wrap dim's
         # sends, and the writer sources wrap halos itself (y/z from the
@@ -682,7 +699,10 @@ def update_halo_local(*fields, assembly=None):
         writer's extra kernel boundary (measured on the radius-1 single
         field diffusion step: 0.70 ms vs 1.12 ms at 256^3) — but the plan
         is a compile lottery for standalone or multi-field programs;
-      - `"pallas"` — force the writers where supported.
+      - `"pallas"` — force the writers; raises `GridError` when they
+        cannot serve the call (non-TPU devices, unsupported rank/dtype/
+        shape), so the force is a real contract rather than a silent
+        fallback.
     """
     shared.check_initialized()
     grid = shared.global_grid()

@@ -91,22 +91,27 @@ def compute_step(T, Cp, *, dx, dy, dz, dt, lam):
         rdz2=1.0 / (dz * dz))
 
 
-def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False):
+def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False,
+               assembly="xla"):
     """One diffusion step over per-device local arrays (the user-model of the
     reference: physics written for a single device's block).  With
     `overlap=True` the step is restructured by :func:`igg.hide_communication`
     so the halo collectives are data-independent of the full-domain stencil
     and XLA can overlap them (ParallelStencil's `@hide_communication`,
-    `/root/reference/README.md:9`)."""
+    `/root/reference/README.md:9`).
+
+    `assembly` defaults to "xla" for standalone use (for this radius-1
+    single-field step, XLA fuses the halo select chain into the stencil's
+    output pass — measured 0.70 ms vs 1.12 ms with the Pallas writer at
+    256^3); the compiled paths (:func:`make_multi_step`) override it with
+    a per-signature measured choice instead of trusting this hint."""
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, lam=lam)
-    # assembly="xla": for this radius-1 single-field step, XLA fuses the
-    # halo select chain into the stencil's output pass — measured 0.70 ms
-    # vs 1.12 ms with the (otherwise default) Pallas writer at 256^3.
     if overlap:
         return igg.hide_communication(
             T, lambda Tb, Cpb: compute_step(Tb, Cpb, **kw), Cp,
-            assembly="xla")
-    return igg.update_halo_local(compute_step(T, Cp, **kw), assembly="xla")
+            assembly=assembly)
+    return igg.update_halo_local(compute_step(T, Cp, **kw),
+                                 assembly=assembly)
 
 
 _PALLAS_REQ = (
@@ -177,25 +182,34 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
     rdx2, rdy2, rdz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
     dt_lam = float(dt * lam)
 
-    def xla_steps(T, Cp):
-        from igg.ops import diffusion_compute
+    def build_xla(assembly):
+        def xla_steps(T, Cp):
+            from igg.ops import diffusion_compute
 
-        # Loop-invariant coefficient: hoists the per-element divide out of
-        # the time loop (same trick as the Pallas path).
-        A = dt_lam / Cp
-        comp = lambda Tb, Ab: diffusion_compute(Tb, Ab, rdx2=rdx2,
-                                                rdy2=rdy2, rdz2=rdz2)
+            # Loop-invariant coefficient: hoists the per-element divide out
+            # of the time loop (same trick as the Pallas path).
+            A = dt_lam / Cp
+            comp = lambda Tb, Ab: diffusion_compute(Tb, Ab, rdx2=rdx2,
+                                                    rdy2=rdy2, rdz2=rdz2)
 
-        def one(T):
-            # assembly="xla": see step() — the select chain fuses into the
-            # radius-1 stencil's output pass, beating the writer here.
-            if overlap:
-                return igg.hide_communication(T, comp, A, assembly="xla")
-            return igg.update_halo_local(comp(T, A), assembly="xla")
+            def one(T):
+                if overlap:
+                    return igg.hide_communication(T, comp, A,
+                                                  assembly=assembly)
+                return igg.update_halo_local(comp(T, A), assembly=assembly)
 
-        return lax.fori_loop(0, n_inner, lambda _, T: one(T), T)
+            return lax.fori_loop(0, n_inner, lambda _, T: one(T), T)
 
-    xla_path = igg.sharded(xla_steps, donate_argnums=(0,) if donate else ())
+        return igg.sharded(xla_steps, donate_argnums=(0,) if donate else ())
+
+    from ._dispatch import measured_assembly_path
+
+    # assembly strategy: measured once per signature ("xla" historically
+    # wins this composed radius-1 single-field step; the writers win
+    # standalone/multi-field — no more hard-coded hint).
+    xla_path = measured_assembly_path(
+        build_xla, tag=f"diffusion3d:{n_inner}:{overlap}:{donate}",
+        wrap=lambda fn: lambda T, Cp: (fn(T, Cp), Cp))
 
     def build_pallas_steps():
         from igg.ops import fused_diffusion_steps

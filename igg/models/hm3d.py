@@ -95,7 +95,7 @@ def compute_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta):
 
 def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
                overlap: bool = False, use_pallas: bool = False,
-               pallas_interpret: bool = False):
+               pallas_interpret: bool = False, assembly=None):
     """One coupled step over per-device local arrays; two mutually-coupled
     fields in one grouped halo update (multi-field pipelining,
     `/root/reference/src/update_halo.jl:19-20`).  `overlap=True`
@@ -117,8 +117,10 @@ def local_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
         return fused_hm3d_step(Pe, phi, **kw, interpret=pallas_interpret)
     if overlap:
         return igg.hide_communication(
-            (Pe, phi), lambda Pe, phi: compute_step(Pe, phi, **kw))
-    return igg.update_halo_local(*compute_step(Pe, phi, **kw))
+            (Pe, phi), lambda Pe, phi: compute_step(Pe, phi, **kw),
+            assembly=assembly)
+    return igg.update_halo_local(*compute_step(Pe, phi, **kw),
+                                 assembly=assembly)
 
 
 _PALLAS_REQ = (
@@ -158,15 +160,23 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     # NOTE: the step closures capture only hashable scalars so recreated
     # closures share one compiled program (`igg.parallel._fn_key`).
 
-    def xla_steps(Pe, phi):
-        return lax.fori_loop(
-            0, n_inner,
-            lambda _, S: local_step(*S, dx=dx, dy=dy, dz=dz, dt=dt,
-                                    phi0=phi0, npow=npow, eta=eta,
-                                    overlap=overlap),
-            (Pe, phi))
+    def build_xla(assembly):
+        def xla_steps(Pe, phi):
+            return lax.fori_loop(
+                0, n_inner,
+                lambda _, S: local_step(*S, dx=dx, dy=dy, dz=dz, dt=dt,
+                                        phi0=phi0, npow=npow, eta=eta,
+                                        overlap=overlap, assembly=assembly),
+                (Pe, phi))
 
-    xla_path = igg.sharded(xla_steps, donate_argnums=(0, 1) if donate else ())
+        return igg.sharded(xla_steps,
+                           donate_argnums=(0, 1) if donate else ())
+
+    from ._dispatch import measured_assembly_path
+
+    xla_path = measured_assembly_path(
+        build_xla, tag=f"hm3d:{n_inner}:{overlap}:{donate}",
+        wrap=lambda fn: fn)
 
     def build_pallas_steps():
         from igg.ops import fused_hm3d_steps

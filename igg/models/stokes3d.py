@@ -130,7 +130,7 @@ def compute_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
 
 def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
                     overlap: bool = False, use_pallas: bool = False,
-                    pallas_interpret: bool = False):
+                    pallas_interpret: bool = False, assembly=None):
     """One pseudo-transient iteration over per-device local arrays.
 
     With `overlap=False`: compute, then one grouped exchange for everything
@@ -162,9 +162,9 @@ def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
             (P, Vx, Vy, Vz),
             lambda P, Vx, Vy, Vz, Rho: compute_iteration(P, Vx, Vy, Vz, Rho,
                                                          **kw),
-            Rho, radius=2)
+            Rho, radius=2, assembly=assembly)
     P, Vx, Vy, Vz = compute_iteration(P, Vx, Vy, Vz, Rho, **kw)
-    return igg.update_halo_local(P, Vx, Vy, Vz)
+    return igg.update_halo_local(P, Vx, Vy, Vz, assembly=assembly)
 
 
 _PALLAS_REQ = (
@@ -211,16 +211,25 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     # NOTE: the step closures capture only hashable scalars so recreated
     # closures share one compiled program (`igg.parallel._fn_key`).
 
-    def xla_it(P, Vx, Vy, Vz, Rho):
-        return lax.fori_loop(
-            0, n_inner,
-            lambda _, S: local_iteration(*S, Rho, dx=dx, dy=dy, dz=dz,
-                                         mu=mu, dtP=dtP, dtV=dtV,
-                                         overlap=overlap),
-            (P, Vx, Vy, Vz))
+    def build_xla(assembly):
+        def xla_it(P, Vx, Vy, Vz, Rho):
+            return lax.fori_loop(
+                0, n_inner,
+                lambda _, S: local_iteration(*S, Rho, dx=dx, dy=dy, dz=dz,
+                                             mu=mu, dtP=dtP, dtV=dtV,
+                                             overlap=overlap,
+                                             assembly=assembly),
+                (P, Vx, Vy, Vz))
 
-    xla_path = igg.sharded(xla_it,
+        return igg.sharded(xla_it,
                            donate_argnums=(0, 1, 2, 3) if donate else ())
+
+    from ._dispatch import measured_assembly_path
+
+    xla_path = measured_assembly_path(
+        build_xla, tag=f"stokes3d:{n_inner}:{overlap}:{donate}",
+        wrap=lambda fn: lambda P, Vx, Vy, Vz, Rho: (*fn(P, Vx, Vy, Vz, Rho),
+                                                    Rho))
 
     def build_pallas_steps():
         from igg.ops import fused_stokes_iteration

@@ -59,6 +59,14 @@ if me == 1:
     assert out1 is not None and out1.shape == (12, 12, 12), out1.shape
 else:
     assert out1 is None
+# Checkpoint round-trip across controllers (shared filesystem; pins the
+# docstring contract of igg/checkpoint.py: process-0 write + barrier +
+# every-process read + cross-process device_put).
+ck = outfile + ".ckpt.npz"
+igg.save_checkpoint(ck, A=A)
+B = igg.load_checkpoint(ck)["A"]
+import jax.numpy as jnp
+assert bool(jnp.all(B == A)), "multihost checkpoint roundtrip mismatch"
 igg.tic(); igg.toc()
 igg.finalize_global_grid()
 """
