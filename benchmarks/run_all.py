@@ -38,7 +38,13 @@ def run(script: str, args, *, virtual: int = 0, tag: str,
         sys.exit(1)
     results = RESULTS if results is None else results
     results.mkdir(exist_ok=True)
-    (results / f"{tag}.jsonl").write_text(out.stdout)
+    if out.stdout.strip():
+        (results / f"{tag}.jsonl").write_text(out.stdout)
+    else:
+        # A benchmark that skipped cleanly (e.g. overlap_schedule without a
+        # TPU toolchain) must not truncate a committed artifact.
+        print(f"=== {tag}: no output (skipped); artifact left untouched",
+              file=sys.stderr)
     sys.stdout.write(out.stdout)
 
 

@@ -54,10 +54,22 @@ def measured_assembly_path(build_variant, *, tag: str, wrap):
     at most once per variant).  `wrap(fn)` adapts the step to a
     state-preserving `state -> state` function for `igg.time_steps` (the
     measurement runs on scratch copies, so donation in the real path is
-    unaffected).  On CPU meshes the writers never engage, so the "xla"
-    variant is returned without measurement."""
+    unaffected — note the one-time cost: a full scratch copy of the
+    fields is live alongside the originals during measurement, plus both
+    variants' executables; jobs sized to the donation steady state can
+    pin the choice with `IGG_ASSEMBLY=xla|writer` to skip it).
+
+    The measurement is skipped — with a fixed "writer" default, the
+    engine's standalone-optimal strategy — when it cannot run safely or
+    meaningfully: non-TPU meshes (the writers never engage; "xla"),
+    multi-controller runs (per-process wall clocks could elect different
+    variants and the processes would then execute divergent SPMD
+    programs), or an `IGG_ASSEMBLY` override."""
+    import os
+
     import igg
     from igg import shared
+    from igg.halo import _is_tpu
 
     built = {}
 
@@ -72,9 +84,21 @@ def measured_assembly_path(build_variant, *, tag: str, wrap):
         return built[choice]
 
     def dispatch(*args):
+        import jax
+
+        from igg import halo
+
         grid = shared.global_grid()
-        if grid.mesh.devices.flat[0].platform != "tpu":
+        if not (_is_tpu(grid) or halo._FORCE_WRITER_INTERPRET):
             return variant("xla")(*args)
+        forced = os.environ.get("IGG_ASSEMBLY")
+        if forced in ("xla", "writer"):
+            return variant(forced)(*args)
+        if jax.process_count() > 1:
+            # No cross-process agreement protocol for a measured choice;
+            # a per-process pick could diverge and the SPMD programs with
+            # it.  Fixed default instead.
+            return variant("writer")(*args)
         key = (tag, shared.grid_epoch(),
                tuple((a.shape, str(a.dtype)) for a in args))
         choice = _ASSEMBLY_CHOICE.get(key)

@@ -201,3 +201,58 @@ def test_trapezoid_3d_kernel_matches_window():
     ref = jax.jit(window)(Text, A_ext)
     scale = float(jnp.max(jnp.abs(ref)))
     assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_stokes_kernel_compiled_matches_xla():
+    """Round 4: the mesh-capable fused Stokes kernel COMPILED on the chip
+    (engine-routed x planes, staggered per-field halo modes) vs the XLA
+    composition — the interpret-mode equivalence is pinned on CPU by
+    tests/test_stokes_pallas.py; this pins the Mosaic lowering."""
+    import jax.numpy as jnp
+
+    from igg.models import stokes3d
+
+    igg.init_global_grid(64, 64, 64, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    params = stokes3d.Params()
+    fields = stokes3d.init_fields(params, dtype=np.float32)
+    it_x = stokes3d.make_iteration(params, n_inner=2, donate=False,
+                                   use_pallas=False)
+    it_p = stokes3d.make_iteration(params, n_inner=2, donate=False,
+                                   use_pallas=True)
+    Sx = Sp = fields[:4]
+    Rho = fields[4]
+    for _ in range(2):
+        Sx = it_x(*Sx, Rho)
+        Sp = it_p(*Sp, Rho)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), Sx, Sp):
+        d = float(jnp.max(jnp.abs(a - b)))
+        s = float(jnp.max(jnp.abs(a))) + 1e-30
+        assert d / s < 1e-5, (name, d, s)
+    igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_hm3d_kernel_compiled_matches_xla():
+    """Round 4: the mesh-capable fused HM3D kernel COMPILED on the chip
+    (engine-routed x planes, single-step emit_slabs=False and the
+    slab-carry multi-step) vs the XLA composition."""
+    import jax.numpy as jnp
+
+    from igg.models import hm3d
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    params = hm3d.Params()
+    Pe, phi = hm3d.init_fields(params, dtype=np.float32)
+    ref = hm3d.make_step(params, n_inner=3, donate=False, use_pallas=False)
+    pal = hm3d.make_step(params, n_inner=3, donate=False, use_pallas=True)
+    Sr = ref(Pe, phi)
+    Sp = pal(Pe, phi)
+    for name, a, b in zip(("Pe", "phi"), Sr, Sp):
+        d = float(jnp.max(jnp.abs(a - b)))
+        s = float(jnp.max(jnp.abs(a))) + 1e-30
+        assert d / s < 1e-5, (name, d, s)
+    igg.finalize_global_grid()
