@@ -165,3 +165,23 @@ def test_bfloat16_and_path_and_names(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(out["file"], np.float32), np.asarray(T, np.float32))
     igg.finalize_global_grid()
+
+
+def test_rank4_roundtrip_and_redistribute(tmp_path):
+    """Rank-4 component-stacked fields checkpoint and redistribute like
+    rank-3 ones (trailing dims unsharded)."""
+    from helpers import encoded_field
+
+    igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)       # (2,2,2)
+    U = igg.update_halo(encoded_field((6, 6, 6, 2)))
+    igg.save_checkpoint(tmp_path / "r4.npz", U=U)
+    out = igg.load_checkpoint(tmp_path / "r4.npz")["U"]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(U))
+    want = np.asarray(igg.gather_interior(U))
+    igg.finalize_global_grid()
+
+    igg.init_global_grid(10, 6, 6, dimx=1, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    out = igg.load_checkpoint(tmp_path / "r4.npz", redistribute=True)["U"]
+    np.testing.assert_array_equal(np.asarray(igg.gather_interior(out)), want)
+    igg.finalize_global_grid()

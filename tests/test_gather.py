@@ -103,3 +103,24 @@ class TestGather:
         np.testing.assert_array_equal(
             gather_mod._fetch_global(A, chunk_bytes=1024).reshape(whole.shape),
             whole.reshape(whole.shape))
+
+
+class TestRank4:
+    """Rank-4 component-stacked fields through gather/gather_interior
+    (trailing dims unsharded — rank-generic like GGArray{T,N})."""
+
+    def test_gather_and_interior(self):
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)   # (2,2,2)
+        A = encoded_field((6, 6, 6, 3))
+        g = igg.gather(A)
+        assert g.shape == (12, 12, 12, 3)
+        np.testing.assert_array_equal(g, np.asarray(A))
+        gi = igg.gather_interior(A)
+        # x periodic: 2*(6-2)=8 unique; y/z open: 2*(6-2)+2=10; C kept.
+        assert gi.shape == (8, 10, 10, 3)
+        # every component plane must match the rank-3 gather of the same
+        # encoding offset by 1000*c
+        base = igg.gather_interior(A[..., 0].copy())
+        for c in range(3):
+            np.testing.assert_array_equal(gi[..., c], base + 1000.0 * c)
+        igg.finalize_global_grid()
