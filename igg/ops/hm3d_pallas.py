@@ -15,7 +15,7 @@ the per-rank property of the reference's native tier — not just the
 single-device configuration.
 
 Measured on v5e at 256^3 f32 (median-of-3, 100-step dispatches, self-wrap
-grid): **0.71 ms/step vs 2.90 for the XLA composition — 4.1x** (the largest
+grid): **0.64 ms/step vs 2.92 for the XLA composition — 4.6x** (the largest
 native-tier gain of the three model kernels: the nonlinear per-step
 `(phi/phi0)^n` permeabilities and two coupled interior updates cost the
 XLA path many extra HBM passes that all fuse here), matching the XLA path

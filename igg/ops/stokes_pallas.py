@@ -17,8 +17,8 @@ tier (">10x faster" than the array-broadcast form,
 reference's native tier — not just the single-device configuration.
 
 Measured on v5e at 128^3 f32 (median-of-3, 100-iteration dispatches,
-self-wrap grid): **0.122 ms/iter** vs 0.257 for the XLA composition
-(2.1x; round-4 artifact refresh of the rewritten mesh-capable kernel);
+self-wrap grid): **0.138 ms/iter** vs 0.278 for the XLA composition
+(2.0x; round-4 artifact refresh of the rewritten mesh-capable kernel);
 matches the XLA path to ~1e-7 relative on the chip (identical
 `iteration_core` arithmetic).  The DMA floor of this structure measured
 with a no-op core is 0.108 ms (~790 GB/s on ~85 MB/iter of traffic,
