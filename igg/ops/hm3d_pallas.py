@@ -15,11 +15,14 @@ the per-rank property of the reference's native tier — not just the
 single-device configuration.
 
 Measured on v5e at 256^3 f32 (median-of-3, 100-step dispatches, self-wrap
-grid): **0.64 ms/step vs 2.92 for the XLA composition — 4.6x** (the largest
-native-tier gain of the three model kernels: the nonlinear per-step
-`(phi/phi0)^n` permeabilities and two coupled interior updates cost the
-XLA path many extra HBM passes that all fuse here), matching the XLA path
-to float32 rounding; `benchmarks/results/overlap_study.jsonl`.
+grid): **0.64 ms/step vs 2.92 for the XLA composition — 4.6x** — the
+largest native-tier gain of the three model kernels: the nonlinear
+per-step `(phi/phi0)^n` permeabilities and two coupled interior updates
+cost the XLA path many extra HBM passes that all fuse here.  Matches the
+XLA path to float32 rounding; `benchmarks/results/overlap_study.jsonl`.
+On self-wrap grids the time loop goes further still: `fused_hm3d_steps`
+routes it through the two-field K-step mega-kernel at **0.48 ms/step —
+6.1x** (`igg/ops/hm3d_mega.py`).
 
 Structure (the two-field radius-1 instance of the `diffusion_pallas`
 recipe; see that module's docstring for the design rationale):
@@ -375,11 +378,21 @@ def fused_hm3d_steps(Pe, phi, *, n_inner, dx, dy, dz, dt, phi0, npow, eta,
     from jax import lax
 
     from .. import shared
+    from .diffusion_pallas import _self_wrap_all
 
     grid = shared.global_grid()
     bx, dims_active = _check_applicable(grid, Pe.shape, bx)
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
     wrap_yz = _wrap_dims(grid)
+
+    if _self_wrap_all(grid):
+        from .hm3d_mega import fused_hm3d_megasteps, hm3d_mega_supported
+
+        # Fastest: the whole inner loop as ONE pallas_call with manual DMA
+        # and HBM ping-pong for both fields (see `hm3d_mega`).
+        if hm3d_mega_supported(Pe.shape, bx, n_inner, interpret, Pe.dtype):
+            return fused_hm3d_megasteps(Pe, phi, n_inner=n_inner, bx=bx,
+                                        **kw)
 
     init_slabs = _boundary_slabs(Pe, phi, wrap_yz)
     keep = [j for j, sl in enumerate(init_slabs) if sl is not None]

@@ -256,3 +256,38 @@ def test_hm3d_kernel_compiled_matches_xla():
         s = float(jnp.max(jnp.abs(a))) + 1e-30
         assert d / s < 1e-5, (name, d, s)
     igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_hm3d_mega_matches_per_step_kernel():
+    """The two-field K-step HM3D mega-kernel (manual DMA, HBM ping-pong for
+    both fields) must match K applications of the per-step fused kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from igg.models import hm3d
+    from igg.ops.hm3d_mega import fused_hm3d_megasteps, hm3d_mega_supported
+    from igg.ops.hm3d_pallas import fused_hm3d_step
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    params = hm3d.Params()
+    Pe, phi = hm3d.init_fields(params, dtype=np.float32)
+    Pe, phi = igg.update_halo(Pe, phi)
+    dx, dy, dz = params.spacing()
+    kw = dict(dx=dx, dy=dy, dz=dz, dt=params.timestep(), phi0=params.phi0,
+              npow=params.npow, eta=params.eta)
+    assert hm3d_mega_supported(Pe.shape, 8, 6, False, Pe.dtype)
+
+    out = jax.jit(lambda Pe, phi: fused_hm3d_megasteps(
+        Pe, phi, n_inner=6, bx=8, **kw))(jnp.array(Pe), jnp.array(phi))
+
+    rp, rf = jnp.array(Pe), jnp.array(phi)
+    step = jax.jit(lambda Pe, phi: fused_hm3d_step(Pe, phi, **kw, bx=8))
+    for _ in range(6):
+        rp, rf = step(rp, rf)
+    for name, a, b in (("Pe", out[0], rp), ("phi", out[1], rf)):
+        d = float(jnp.max(jnp.abs(a - b)))
+        s = float(jnp.max(jnp.abs(b))) + 1e-30
+        assert d / s < 1e-6, (name, d, s)
+    igg.finalize_global_grid()
