@@ -109,8 +109,12 @@ def weak_curve(model_run, model_name: str, n: int, *, nt: int, n_inner: int,
                        "platform": platform},
             "ms_per_step": round(sec * 1e3, 4),
         }
+        # `smoke: true` uniquely marks non-accelerator rows (the provenance
+        # invariant consumers filter on; provenance() already stamps it
+        # from the platform) — a careful CPU-mesh run records its
+        # measurement quality in `reps` instead of clearing the flag.
         if full:
-            rec["smoke"] = False
+            rec["reps"] = 3
         if platform == "cpu":
             model = t1 * k / min(k, cores)
             rec["host_cores"] = cores

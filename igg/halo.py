@@ -197,7 +197,7 @@ def moving_dims(dims_active, grid) -> List[Tuple[int, int]]:
 # ---------------------------------------------------------------------------
 
 def exchange_planes(left_send, right_send, stale_first, stale_last,
-                    d: int, n: int, periodic: bool):
+                    d: int, n: int, periodic: bool, disp: int = 1):
     """Plane-level neighbor shift along mesh axis `d`: returns the
     (new_first, new_last) halo planes of the local block.
 
@@ -206,28 +206,43 @@ def exchange_planes(left_send, right_send, stale_first, stale_last,
     semantics (`/root/reference/test/test_update_halo.jl:727-732`).  With one
     device along the axis, periodic exchange degenerates to a pure local copy
     (self-neighbor path, `/root/reference/src/update_halo.jl:516-532`).
+
+    `disp` is the Cartesian neighbor displacement: partners are the ranks
+    `disp` steps away, the semantics `MPI.Cart_shift` gives the reference's
+    neighbor table (`/root/reference/src/init_global_grid.jl:78-81`) —
+    realized here as ppermute shift tables with stride `disp` (and, when
+    `disp` is a multiple of a periodic axis size, the degenerate self-copy).
     """
     import jax.numpy as jnp
     from jax import lax
 
     axis = AXIS_NAMES[d]
-    if n == 1:
-        if not periodic:
-            return stale_first, stale_last
+    if periodic and disp % n == 0:
+        # Every rank is its own partner (n == 1, or disp wrapping onto
+        # itself): a pure local copy, no collective.
         return right_send, left_send
+    if not periodic and disp >= n:
+        # No rank has a partner `disp` steps away inside an open axis
+        # (includes the open n == 1 case).
+        return stale_first, stale_last
 
-    shift_down = [(i, i - 1) for i in range(1, n)] + ([(0, n - 1)] if periodic else [])
-    shift_up = [(i, i + 1) for i in range(n - 1)] + ([(n - 1, 0)] if periodic else [])
+    shift_down = ([(i, i - disp) for i in range(disp, n)]
+                  + ([(i, (i - disp) % n) for i in range(min(disp, n))]
+                     if periodic else []))
+    shift_up = ([(i, i + disp) for i in range(n - disp)]
+                + ([(i, (i + disp) % n) for i in range(max(n - disp, 0), n)]
+                   if periodic else []))
     from_right = lax.ppermute(left_send, axis, shift_down)   # right nb's inner plane
     from_left = lax.ppermute(right_send, axis, shift_up)     # left nb's inner plane
     if periodic:
         return from_left, from_right
     idx = lax.axis_index(axis)
-    return (jnp.where(idx > 0, from_left, stale_first),
-            jnp.where(idx < n - 1, from_right, stale_last))
+    return (jnp.where(idx >= disp, from_left, stale_first),
+            jnp.where(idx < n - disp, from_right, stale_last))
 
 
-def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool):
+def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool,
+                   disp: int = 1):
     """Exchange dim `d` for a group of same-plane-shape fields: planes are
     SQUEEZED for the wire (dense logical bytes — the keepdims form is
     lane-padded up to ~40x) and, for several fields, stacked so ONE
@@ -240,7 +255,7 @@ def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool):
     if n == 1:
         return [exchange_planes(sends[i][(d, 0)], sends[i][(d, 1)],
                                 stales[i][(d, 0)], stales[i][(d, 1)],
-                                d, n, periodic)
+                                d, n, periodic, disp)
                 for i in members]
 
     def squeeze(P):
@@ -251,7 +266,7 @@ def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool):
         nf_, nl_ = exchange_planes(
             squeeze(sends[i][(d, 0)]), squeeze(sends[i][(d, 1)]),
             squeeze(stales[i][(d, 0)]), squeeze(stales[i][(d, 1)]),
-            d, n, periodic)
+            d, n, periodic, disp)
         return [(jnp.expand_dims(nf_, d), jnp.expand_dims(nl_, d))]
 
     ls = jnp.stack([squeeze(sends[i][(d, 0)]) for i in members])
@@ -261,7 +276,7 @@ def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool):
     else:
         sf = jnp.stack([squeeze(stales[i][(d, 0)]) for i in members])
         sl = jnp.stack([squeeze(stales[i][(d, 1)]) for i in members])
-    nf_, nl_ = exchange_planes(ls, rs, sf, sl, d, n, periodic)
+    nf_, nl_ = exchange_planes(ls, rs, sf, sl, d, n, periodic, disp)
     return [(jnp.expand_dims(nf_[k], d), jnp.expand_dims(nl_[k], d))
             for k in range(len(members))]
 
@@ -384,7 +399,8 @@ def exchange_all_dims_grouped(shapes, sends, dims_actives, grid,
             P = sends[i][(d, 0)]
             groups.setdefault((tuple(P.shape), str(P.dtype)), []).append(i)
         for shape_key, members in groups.items():
-            per_field = _wire_exchange(members, sends, stales, d, n, periodic)
+            per_field = _wire_exchange(members, sends, stales, d, n, periodic,
+                                       getattr(grid, "disp", 1))
             for i, (new_first, new_last) in zip(members, per_field):
                 recvs[i][d] = (new_first, new_last)
                 s = shapes[i]
@@ -430,14 +446,46 @@ def _slab_sizes(shape, dtype) -> Dict[int, int]:
     return out
 
 
-def _assembly_plan(shape, dtype, dims) -> str:
+def _assembly_plan(shape, dtype, dims, on_tpu: bool = False) -> str:
     """'dus' when every participating dimension admits a tile-aligned
     in-place slab update (size a multiple of its tile and at least two
     tiles), else 'select'.  Measured at 256^3: the two plans tie for f32
     xyz (~165 us), DUS wins for bf16 xyz (138 vs 211 us) and wins big when
     the lane dim does not participate (xy: 9-20 us vs a full pass), so DUS
     is preferred whenever feasible; select is the fallback for small or
-    unaligned local shapes (e.g. the CPU-mesh test grids)."""
+    unaligned local shapes (e.g. the CPU-mesh test grids).
+
+    8-byte dtypes on TPU (f64 — the reference's Julia default — plus
+    complex64, and complex128 at 16 bytes) are emulated by the XLA:TPU
+    x64/complex rewriter as pairs of f32 arrays.  Under that emulation the
+    op mix decides everything (round-5 on-chip study, 256^3 f64):
+
+      - graphs of bare `dynamic_update_slice` ops stay native data
+        movement — the whole x+y boundary-slab update compiles to
+        in-place plane writes;
+      - ONE select/where anywhere drags the entire graph into pair land:
+        the block is X64Split into two f32 arrays, every DUS is rewritten
+        against the halves, and defensive full-array copies appear around
+        the in-place updates (measured: the same x+y DUS chain jumps to
+        ~0.9-1.3 ms when a lane select joins the program; 3 chained
+        selects never fuse — 1473 us — the round-4 superlinear grouped
+        rows).
+
+    A lane-dim halo cannot avoid the select (per-lane DUS costs a full
+    relayout pass, 348 us; lane concat 920 us), so lane-ACTIVE f64 sets
+    keep the round-4 aligned-DUS/select plan — its 595 us/field at 256^3
+    sits at the pair-emulation floor (one fused select pass measures
+    322 us, split+combine+copies make up the rest; an all-select
+    single-fusion attempt and a DUS+select hybrid both measured ~600 us).
+    Halo sets that DON'T touch the lane dim get the 'dus64' plan: bare
+    plane DUSes for every active dim, nothing elementwise — 437 us vs
+    641 us per field at 256^3 x+y, and strictly linear in the field count
+    (4 fields 1765 us vs the superlinear 3243 us)."""
+    import numpy as np
+
+    if (on_tpu and np.dtype(dtype).itemsize >= 8
+            and (len(shape) - 1) not in dims):
+        return "dus64"
     slabs = _slab_sizes(shape, dtype)
     for d in dims:
         t = slabs[d]
@@ -470,6 +518,23 @@ def assemble_planes(out, recv: Dict, dims_active, plan: Optional[str] = None):
             idx = lax.broadcasted_iota(jnp.int32, s, d)
             out = jnp.where(idx == 0, recv[d][0],
                             jnp.where(idx == s[d] - 1, recv[d][1], out))
+        return out
+    if plan == "dus64":
+        # Pair-emulated 8/16-byte dtypes (see `_assembly_plan`): bare plane
+        # DUSes for every non-lane dim (pure data movement under the x64/
+        # complex rewriter), one nested-select pass for the lane dim only.
+        # Dims ascend, so the lane pass runs last and wins the corners.
+        lane = len(s) - 1
+        for d in dims:
+            first, last = recv[d]
+            if d == lane:
+                idx = lax.broadcasted_iota(jnp.int32, s, d)
+                out = jnp.where(idx == 0, first,
+                                jnp.where(idx == s[d] - 1, last, out))
+            else:
+                out = lax.dynamic_update_slice_in_dim(out, first, 0, axis=d)
+                out = lax.dynamic_update_slice_in_dim(out, last, s[d] - 1,
+                                                      axis=d)
         return out
 
     slabs = _slab_sizes(s, out.dtype)
@@ -539,15 +604,18 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
     from .ops.halo_write import halo_write_slabs, write_lane_active
 
     _check_assembly(assembly)
+    xla_plan = _assembly_plan(out.shape, out.dtype,
+                              [d for d, _ in dims_active],
+                              on_tpu=_is_tpu(grid))
     if assembly == "xla" or not (_is_tpu(grid) or _FORCE_WRITER_INTERPRET):
         if assembly == "pallas":
             raise GridError(_PALLAS_NEEDS_TPU)
-        return assemble_planes(out, recv, dims_active)
+        return assemble_planes(out, recv, dims_active, plan=xla_plan)
     _, use_writer = _writer_dims(out, dims_active, grid)
     if not use_writer:
         if assembly == "pallas":
             raise GridError(_PALLAS_UNSUPPORTED)
-        return assemble_planes(out, recv, dims_active)
+        return assemble_planes(out, recv, dims_active, plan=xla_plan)
     specs = [(d, "ext", jnp.squeeze(recv[d][0], d),
               jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
     interp = _FORCE_WRITER_INTERPRET
@@ -657,7 +725,9 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
     for i, A in enumerate(fields):
         dims = dims_moving[i]
         if not writer[i]:
-            out.append(assemble_planes(A, recvs[i], dims))
+            plan = _assembly_plan(A.shape, A.dtype, [d for d, _ in dims],
+                                  on_tpu=on_tpu)
+            out.append(assemble_planes(A, recvs[i], dims, plan=plan))
             continue
         s = A.shape
         lane_active = any(d == A.ndim - 1 for d, _ in dims)

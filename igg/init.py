@@ -85,6 +85,14 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
     # (`/root/reference/src/init_global_grid.jl:66`).
     dims[(nxyz == 1) & (dims == 0)] = 1
 
+    # `disp` is honored by the exchange (partners `disp` ranks away, the
+    # `MPI.Cart_shift` semantics of `/root/reference/src/init_global_grid.jl:
+    # 78-81`); negative displacements (role-swapped shifts) are not
+    # meaningful for a halo update and are rejected eagerly.
+    if disp < 1:
+        raise GridError("Invalid arguments: disp must be a positive integer "
+                        "(neighbor displacement of the Cartesian shift).")
+
     if init_distributed:
         jax.distributed.initialize()
 
@@ -139,15 +147,16 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
               f"(nprocs: {nprocs}, dims: {dims[0]}x{dims[1]}x{dims[2]})")
 
     # Warm up the timing functions (`/root/reference/src/init_global_grid.jl:86,91-94`).
-    from .tools import tic, toc
-    try:
+    # Skipped — rather than try/except-ed, which would also swallow real
+    # timer failures — when the mesh holds devices the runtime cannot
+    # execute on (AOT compile-only topologies, e.g.
+    # `benchmarks/overlap_schedule.py` compiling an 8-chip SPMD program on
+    # a 1-chip host); the timers warm up on first real use there.  In
+    # multi-controller runs `jax.devices()` spans all hosts, so the
+    # collective warm-up barrier still runs.
+    if set(mesh.devices.flat) <= set(jax.devices()):
+        from .tools import tic, toc
         tic()
         toc()
-    except Exception:
-        # Grids over non-addressable devices (AOT compile-only topologies,
-        # e.g. `benchmarks/overlap_schedule.py` compiling the 8-chip SPMD
-        # program on a 1-chip host) cannot execute the warm-up barrier;
-        # the timers warm up on first real use instead.
-        pass
 
     return me, tuple(int(v) for v in dims), int(nprocs), coords, mesh

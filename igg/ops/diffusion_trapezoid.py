@@ -86,6 +86,11 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
 
     if n_inner < bx or bx < 2:
         return False
+    if getattr(grid, "disp", 1) != 1:
+        # The chunked slab exchange hardwires +-1 ppermute tables
+        # (`_extend_dim`); disp > 1 grids take the per-step path, whose
+        # engine-level exchange honors `grid.disp`.
+        return False
     ok, y_ext, z_ext = _mode(grid)
     if not ok:
         return False

@@ -23,7 +23,11 @@ matches the XLA path to ~1e-7 relative on the chip (identical
 `iteration_core` arithmetic).  The DMA floor of this structure measured
 with a no-op core is 0.108 ms (~790 GB/s on ~85 MB/iter of traffic,
 including the 2x lane padding of Vz's (S,S,S+1) shape), so the remaining
-gap to ideal is non-overlapped VPU time.
+gap to ideal is non-overlapped VPU time.  `docs/stokes_roofline.md`
+carries the full traffic accounting: the structure is jointly DMA- and
+VPU-bound and its ceiling is ~2.3-2.6x — no per-iteration kernel of
+this solver reaches 3x at f32 128^3; only temporal blocking or bf16
+break the bound.
 
 Structure (the radius-2 staggered four-field instance of the
 `diffusion_pallas` recipe):

@@ -57,7 +57,7 @@ class GlobalGrid:
     me: int                           # rank of this controller process
     coords: Tuple[int, int, int]      # cartesian coords of this process
     periods: Tuple[int, int, int]     # periodicity per dimension (0/1)
-    disp: int                         # neighbor displacement (parity; always 1)
+    disp: int                         # Cartesian-shift displacement (>= 1), honored by the exchange
     reorder: int                      # whether device placement may be optimized
     mesh: object                      # jax.sharding.Mesh over the device grid
     quiet: bool

@@ -213,6 +213,41 @@ class TestMeasuredAssemblyDispatch:
         d(jnp.zeros((6, 6, 6)))
         assert built == ["xla"]
 
+    def test_election_survives_noisy_timer(self):
+        """VERDICT r4 weak item 6: a single noisy measurement must not pin
+        the wrong variant.  The injected timer gives 'xla' one spuriously
+        fast first sample (single-shot election would pick it); the
+        median-of-k close-margin re-measure elects the true winner."""
+        from igg.models._dispatch import _elect
+
+        # true costs: xla ~0.110 s, writer ~0.100 s; first xla sample is a
+        # noisy 0.090 (20% low).
+        scripted = {"xla": [0.090, 0.112, 0.111],
+                    "writer": [0.100, 0.099, 0.098]}
+        calls = {"xla": 0, "writer": 0}
+
+        def measure(name):
+            v = scripted[name][calls[name]]
+            calls[name] += 1
+            return v
+
+        assert _elect(measure) == "writer"
+        assert calls["xla"] >= 2  # it actually re-measured
+
+    def test_election_fast_path_when_separated(self):
+        """Well-separated variants are elected after ONE measurement each
+        (the measurement cost stays two compiles + two timings)."""
+        from igg.models._dispatch import _elect
+
+        calls = {"xla": 0, "writer": 0}
+
+        def measure(name):
+            calls[name] += 1
+            return {"xla": 0.200, "writer": 0.100}[name]
+
+        assert _elect(measure) == "writer"
+        assert calls == {"xla": 1, "writer": 1}
+
 
 class TestEndToEnd4D:
     """Rank-4 component-stacked fields `(nx, ny, nz, C)` (VERDICT r3 item
@@ -296,6 +331,60 @@ class TestEndToEnd2D1D:
         np.testing.assert_array_equal(out, exp)
 
 
+class TestDisp:
+    """`disp` is honored: exchange partners sit `disp` ranks away, the
+    `MPI.Cart_shift` semantics the reference builds its neighbor table with
+    (`/root/reference/src/init_global_grid.jl:78-81`)."""
+
+    @staticmethod
+    def _rank_blocks(nx):
+        return igg.from_local_blocks(
+            lambda coords, ls: np.full(ls, float(coords[0])), (nx, 2, 2))
+
+    def test_disp2_periodic(self):
+        igg.init_global_grid(8, 2, 2, dimx=8, dimy=1, dimz=1, periodx=1,
+                             disp=2, quiet=True)
+        out = np.array(igg.update_halo(self._rank_blocks(8)))
+        for c in range(8):
+            blk = out[c * 8:(c + 1) * 8]
+            assert blk[0, 0, 0] == (c - 2) % 8, (c, blk[0, 0, 0])
+            assert blk[-1, 0, 0] == (c + 2) % 8, (c, blk[-1, 0, 0])
+        g = igg.get_global_grid()
+        assert g.neighbors_of((3, 0, 0), 0) == (g.cart_rank((1, 0, 0)),
+                                                g.cart_rank((5, 0, 0)))
+
+    def test_disp2_open_edges_keep_stale(self):
+        igg.init_global_grid(8, 2, 2, dimx=8, dimy=1, dimz=1, disp=2,
+                             quiet=True)
+        out = np.array(igg.update_halo(self._rank_blocks(8)))
+        for c in range(8):
+            blk = out[c * 8:(c + 1) * 8]
+            # ranks 0/1 have no left partner, 6/7 no right partner:
+            # the no-write (stale) semantics of open boundaries.
+            exp_first = float(c) if c < 2 else (c - 2)
+            exp_last = float(c) if c >= 6 else (c + 2)
+            assert blk[0, 0, 0] == exp_first, (c, blk[0, 0, 0])
+            assert blk[-1, 0, 0] == exp_last, (c, blk[-1, 0, 0])
+
+    def test_disp_wrap_multiple_is_self_copy(self):
+        # disp == 2 on a periodic 2-device axis: every rank is its own
+        # partner — halos come from the rank's own inner planes.
+        igg.init_global_grid(6, 6, 2, dimx=2, dimy=2, dimz=2, periodx=1,
+                             disp=2, quiet=True)
+        A = igg.from_local_blocks(
+            lambda coords, ls: np.full(ls, float(coords[0])), (6, 6, 2))
+        out = np.array(igg.update_halo(A))
+        for c in range(2):
+            blk = out[c * 6:(c + 1) * 6]
+            assert (blk[0] == c).all() and (blk[-1] == c).all()
+
+    def test_disp_nonpositive_rejected(self):
+        with pytest.raises(igg.GridError, match="disp"):
+            igg.init_global_grid(8, 8, 8, disp=0, quiet=True)
+        with pytest.raises(igg.GridError, match="disp"):
+            igg.init_global_grid(8, 8, 8, disp=-1, quiet=True)
+
+
 class TestMultiField:
     def test_two_fields_at_once(self):
         igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
@@ -330,11 +419,59 @@ class TestMultiField:
 
 class TestDtypes:
     @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.float16,
-                                       np.complex64])
+                                       np.complex64, np.complex128])
     def test_dtype_roundtrip(self, dtype):
+        # complex64/complex128 ride the XLA fallback plans (no writer
+        # support), matching the reference's any-Number element contract
+        # (`/root/reference/src/shared.jl:31`, ComplexF16 end-to-end in
+        # `/root/reference/test/test_update_halo.jl` §2/§4).
         igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
         out, exp = roundtrip((6, 6, 6), dtype=dtype)
         np.testing.assert_array_equal(out, exp.astype(dtype))
+
+    @pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+    def test_complex_open_boundaries(self, dtype):
+        igg.init_global_grid(6, 6, 6, quiet=True)  # (2,2,2), all open
+        out, exp = roundtrip((6, 6, 6), dtype=dtype)
+        np.testing.assert_array_equal(out, exp.astype(dtype))
+
+    @pytest.mark.parametrize("shape,dims", [
+        ((5, 6, 7), [0]), ((5, 6, 7), [1]), ((5, 6, 7), [2]),
+        ((5, 6, 7), [0, 1]), ((5, 6, 7), [0, 1, 2]),
+        ((6, 7), [0, 1]), ((4, 5, 6, 3), [0, 1, 2]),
+    ])
+    @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+    def test_dus64_plan_matches_select(self, shape, dims, dtype):
+        """The TPU plan for pair-emulated 8/16-byte dtypes (bare plane DUS
+        for non-lane dims + one nested-select lane pass — see
+        `igg.halo._assembly_plan`) writes exactly what the reference select
+        plan writes, for every rank and participating-dim subset."""
+        from igg.halo import _assembly_plan, assemble_planes
+
+        rng = np.random.default_rng(7)
+        def mk(s):
+            a = rng.standard_normal(s)
+            return (a + 1j * rng.standard_normal(s)
+                    if np.dtype(dtype).kind == "c" else a).astype(dtype)
+        A = mk(shape)
+        recv = {}
+        for d in dims:
+            ps = list(shape)
+            ps[d] = 1
+            recv[d] = (mk(tuple(ps)), mk(tuple(ps)))
+        dims_active = [(d, 2) for d in dims]
+        got = np.array(assemble_planes(A, recv, dims_active, plan="dus64"))
+        ref = np.array(assemble_planes(A, recv, dims_active, plan="select"))
+        np.testing.assert_array_equal(got, ref)
+        # Auto-selection: dus64 only for 8/16-byte dtypes on TPU when the
+        # lane dim is not active (lane halos need a select, which drags
+        # the graph into pair-emulation land — `_assembly_plan` docstring).
+        lane_active = (len(shape) - 1) in dims
+        expect = "dus64" if not lane_active else ("dus", "select")
+        plan = _assembly_plan(shape, dtype, dims, on_tpu=True)
+        assert plan == expect if isinstance(expect, str) else plan in expect
+        assert _assembly_plan(shape, dtype, dims) in ("dus", "select")
+        assert _assembly_plan(shape, np.float32, dims, on_tpu=True) != "dus64"
 
     def test_bfloat16(self):
         import jax.numpy as jnp
