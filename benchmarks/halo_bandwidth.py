@@ -18,16 +18,18 @@ on two halo sets:
     `igg/ops/halo_write.py` for the full roofline argument.
   - `xy`: x/y periodic, z open — the halo set of the *recommended*
     `(N,M,1)` pod decompositions (z unsplit).  The per-dim slab writers
-    touch only the dirty boundary tiles: ~22 us at 256^3 f32, again linear
-    in the field count.
+    touch only the dirty boundary tiles: ~20-35 us at 256^3 f32 (the
+    measurement floor of the slope timer — run-to-run spread at this
+    timescale is ~2x), again linear in the field count.
 
 The headline "GB/s effective" divides the logical halo bytes (12 planes =
 `12*S^2*b`) by the wall time; for `xyz` the tile-granularity floor (an RMW
 pass moving `2*S^3*b`) makes it `6/S` of the RMW rate by construction
 (~15 GB/s at S=256 — NOT a statement about the engine's efficiency, which
 is at the floor; bf16 moves half the bytes in half the time, so its
-effective GB/s equals f32's).  `xy` reflects real slab traffic (~86 GB/s
-at 256^3).
+effective GB/s equals f32's).  `xy` reflects real slab traffic
+(~45-100 GB/s at 256^3, spread dominated by timer noise at the ~25 us
+scale).
 
 Accounting (stated so numbers are comparable across runs): per field and per
 participating dimension, every chip sends 2 boundary planes and receives 2 —
@@ -98,8 +100,10 @@ def main():
     import jax.numpy as jnp
 
     # f16 on CPU; bf16 + f64 on accelerators (f64 = the reference's Julia
-    # default, on the pinned aligned-DUS XLA plan — VERDICT r3 item 4; see
-    # igg/ops/halo_write.py for why the writers' u32 view is TPU-blocked).
+    # default, on the barrier-fenced op-mix XLA plans — 'select' for
+    # lane-active sets, 'dus64' otherwise; igg.halo._assembly_plan has the
+    # measured rules, igg/ops/halo_write.py why the writers' u32 view is
+    # TPU-blocked).
     # x64 is enabled only around the f64 measurement: under a global x64
     # flag, pallas BlockSpec index maps trace as i64 and Mosaic rejects
     # them ('func.return (i64, i64)'), breaking the f32/bf16 writer paths.

@@ -31,8 +31,10 @@ own evidence):
   pinned `cause` string.
 
 Usage: `python benchmarks/weak_scaling.py [local_n] [nt] [n_inner] [--full]`
-(`--full` marks the artifact as a full-quality measured run: smoke=false,
-median-of-3 per point).
+(`--full` measures median-of-3 per point and records `reps: 3`; the
+`smoke` flag always reflects the platform — CPU-mesh rows stay
+`smoke: true` however carefully measured, so they can never be mistaken
+for accelerator evidence).
 """
 
 from __future__ import annotations

@@ -158,19 +158,38 @@ def _plane(A, d: int, i: int):
     return lax.slice_in_dim(A, i, i + 1, axis=d)
 
 
-def _put_row(P, row, axis: int, i: int):
-    """Row substitution in masked-select form rather than
-    dynamic-update-slice: the result stays a lazy elementwise expression
-    over `P` and `row`, so plane patches fuse into whatever consumes the
-    plane.  A DUS here forces the (possibly lazily-sliced) plane to
-    materialize, and materializing a minor-dim plane is a relayout pass
-    over the source tiles — measured ~90 us per plane pair at 256^3 f32,
-    turning a 160 us update into 560 us."""
+def _put_row(P, row, axis: int, i: int, form: str = "where"):
+    """Row substitution in a pending plane.  Default masked-select form
+    rather than dynamic-update-slice: the result stays a lazy elementwise
+    expression over `P` and `row`, so plane patches fuse into whatever
+    consumes the plane.  A DUS here forces the (possibly lazily-sliced)
+    plane to materialize, and materializing a minor-dim plane is a
+    relayout pass over the source tiles — measured ~90 us per plane pair
+    at 256^3 f32, turning a 160 us update into 560 us.
+
+    `form="dus"` is for pair-emulated 8/16-byte dtypes on the all-DUS
+    'dus64' assembly plan, where the rule is reversed: ONE select
+    anywhere in the x64/complex-rewritten graph drags every in-place
+    update into defensive pair-split copies (a 441-vs-134 us engine
+    regression at 256^3 f64 x+y), while plane-level DUS is native data
+    movement there — and dus64 planes must materialize for the wire and
+    the block-level DUS anyway, so nothing is lost to the forced
+    materialization.  See `_patch_form`."""
     import jax.numpy as jnp
     from jax import lax
 
+    if form == "dus":
+        return lax.dynamic_update_slice_in_dim(P, row, i, axis=axis)
     idx = lax.broadcasted_iota(jnp.int32, P.shape, axis)
     return jnp.where(idx == i, row, P)
+
+
+def _patch_form(shape, dtype, dims, on_tpu: bool) -> str:
+    """Corner-patch form matched to the field's assembly plan, so the
+    pair-emulated graphs stay homogeneous (`_assembly_plan` docstring):
+    'dus' exactly when the field takes the all-DUS 'dus64' plan."""
+    return ("dus" if _assembly_plan(shape, dtype, dims, on_tpu) == "dus64"
+            else "where")
 
 
 def active_dims(shape, grid) -> List[Tuple[int, int]]:
@@ -281,7 +300,8 @@ def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool,
             for k in range(len(members))]
 
 
-def _patch_pending(store, key, d: int, s, val_first, val_last, pos: int):
+def _patch_pending(store, key, d: int, s, val_first, val_last, pos: int,
+                   form: str = "where"):
     """Overwrite the edge rows along exchanged dimension `d` of a pending
     plane of a *later* dimension `d2 = key[0]` (`d < d2`) with the received
     planes' values at that plane's position `pos` — the sequential
@@ -291,8 +311,8 @@ def _patch_pending(store, key, d: int, s, val_first, val_last, pos: int):
     if P is None:
         return
     d2 = key[0]
-    P = _put_row(P, _plane(val_first, d2, pos), d, 0)
-    P = _put_row(P, _plane(val_last, d2, pos), d, s[d] - 1)
+    P = _put_row(P, _plane(val_first, d2, pos), d, 0, form)
+    P = _put_row(P, _plane(val_last, d2, pos), d, s[d] - 1, form)
     store[key] = P
 
 
@@ -349,6 +369,17 @@ def exchange_all_dims_grouped(shapes, sends, dims_actives, grid,
     stales = [dict(st) if st else {} for st in (stales or [None] * nf)]
     wraps = [frozenset(w or ()) for w in (wraps or [()] * nf)]
 
+    # Corner-patch form per field, matched to its assembly plan so the
+    # pair-emulated 8/16-byte graphs stay homogeneous (`_patch_form`).
+    on_tpu = _is_tpu(grid)
+    forms = []
+    for i in range(nf):
+        P = next(iter(sends[i].values()), None)
+        dt = P.dtype if P is not None else (
+            blocks[i].dtype if blocks is not None else None)
+        forms.append("where" if dt is None else _patch_form(
+            shapes[i], dt, [d for d, _ in dims_actives[i]], on_tpu))
+
     # Stale planes: what an open-boundary edge device keeps (the reference's
     # no-write semantics, `/root/reference/test/test_update_halo.jl:727-732`).
     # Extracted lazily from the block only for non-periodic dims — periodic
@@ -383,8 +414,10 @@ def exchange_all_dims_grouped(shapes, sends, dims_actives, grid,
                         P = store.get((d2, side2))
                         if P is None:
                             continue
-                        P = _put_row(P, _plane(P, d, s[d] - ol), d, 0)
-                        P = _put_row(P, _plane(P, d, ol - 1), d, s[d] - 1)
+                        P = _put_row(P, _plane(P, d, s[d] - ol), d, 0,
+                                     forms[i])
+                        P = _put_row(P, _plane(P, d, ol - 1), d, s[d] - 1,
+                                     forms[i])
                         store[(d2, side2)] = P
 
         if not exch_f:
@@ -411,10 +444,109 @@ def exchange_all_dims_grouped(shapes, sends, dims_actives, grid,
                                                    (1, s[d2] - ol2,
                                                     s[d2] - 1)):
                         _patch_pending(sends[i], (d2, side2), d, s,
-                                       new_first, new_last, p_send)
+                                       new_first, new_last, p_send,
+                                       forms[i])
                         _patch_pending(stales[i], (d2, side2), d, s,
-                                       new_first, new_last, p_stale)
+                                       new_first, new_last, p_stale,
+                                       forms[i])
     return recvs
+
+
+def _pair_emulated(dtype) -> bool:
+    """8/16-byte dtypes the XLA:TPU x64/complex rewriters emulate as pairs
+    of 32-bit arrays (f64, i64/u64, complex64, complex128)."""
+    import numpy as np
+
+    return np.dtype(dtype).itemsize >= 8
+
+
+def _materialize_planes(out, planes):
+    """`optimization_barrier` fence between a block and the halo planes
+    about to be written into it — the KEY unlock for pair-emulated dtypes
+    (round-5 on-chip study): without it, the planes are lazy slices of the
+    very buffer the in-place updates overwrite, XLA's copy-insertion sees a
+    read-after-write hazard against the whole block, and every loop
+    iteration pays full-block defensive copies (the f64 x+y update at
+    256^3 measured 466 us with 4 full copies; with the fence the SAME
+    program is 35 us with zero copies — the fence forces the ~MB of planes
+    to materialize first, which the exchange wire needs anyway).  Returns
+    `(out, planes)` re-fenced; `planes` is a flat list."""
+    from jax import lax
+
+    fenced = lax.optimization_barrier((out, *planes))
+    return fenced[0], list(fenced[1:])
+
+
+def _fence_recv(out, recv: Dict, dims_active, on_tpu: bool):
+    """Apply the `_materialize_planes` fence to a block and its received
+    planes when the dtype is pair-emulated (no-op otherwise); returns the
+    re-fenced `(out, recv)`.  Shared by the engine's grouped XLA assembly
+    and `assemble_field` so the fence invariant cannot desynchronize."""
+    if not (_pair_emulated(out.dtype) and on_tpu):
+        return out, recv
+    dd = [d for d, _ in dims_active]
+    out, flat = _materialize_planes(out, [p for d in dd for p in recv[d]])
+    return out, {d: (flat[2 * j], flat[2 * j + 1])
+                 for j, d in enumerate(dd)}
+
+
+def exchange_assemble_sequential(fields, dims_actives, grid, plans):
+    """Sequential per-dimension exchange-and-assemble for XLA-plan fields:
+    for each dimension in ascending order, send planes are extracted as
+    LAZY slices of the current (partially updated) blocks, exchanged, and
+    assembled straight back into the blocks with the field's plan form.
+
+    This is the reference's literal control flow
+    (`/root/reference/src/update_halo.jl:36,130` — pack/exchange/unpack one
+    dimension at a time), and on TPU it is the right shape for the
+    pair-emulated 8/16-byte dtypes: corner/edge propagation comes for free
+    (later dims' planes are sliced from blocks that already contain the
+    earlier dims' received values), so no `_put_row` patches are needed —
+    and it was exactly those plane-space patches that broke the
+    homogeneous-graph rule of `_assembly_plan` (engine 448 us vs 134 us
+    standalone for the f64 x+y update at 256^3; with this path the engine
+    matches the standalone number).  With one device along a periodic
+    dimension everything stays lazy end-to-end, and the fully fused
+    'select' program runs at the byte-proportional floor (f64 xyz 256^3:
+    one pass at HBM streaming rate).
+
+    The grouped pre-extracted form (:func:`exchange_all_dims_grouped`)
+    remains the engine path for Pallas-writer fields, whose assembly is an
+    opaque kernel that needs all planes materialized up front."""
+    nf = len(fields)
+    vb = list(fields)
+    all_dims = sorted({d for da in dims_actives for d, _ in da})
+    for d in all_dims:
+        fidx = [i for i in range(nf) if d in dict(dims_actives[i])]
+        if not fidx:
+            continue
+        n = grid.dims[d]
+        periodic = bool(grid.periods[d])
+        sends: Dict[int, Dict] = {}
+        stales: Dict[int, Dict] = {}
+        for i in fidx:
+            s = vb[i].shape
+            ol = dict(dims_actives[i])[d]
+            sends[i] = {(d, 0): _plane(vb[i], d, ol - 1),
+                        (d, 1): _plane(vb[i], d, s[d] - ol)}
+            stales[i] = ({(d, 0): None, (d, 1): None} if periodic
+                         else {(d, 0): _plane(vb[i], d, 0),
+                               (d, 1): _plane(vb[i], d, s[d] - 1)})
+        groups: Dict[tuple, List[int]] = {}
+        for i in fidx:
+            P = sends[i][(d, 0)]
+            groups.setdefault((tuple(P.shape), str(P.dtype)), []).append(i)
+        for members in groups.values():
+            per_field = _wire_exchange(members, sends, stales, d, n,
+                                       periodic, getattr(grid, "disp", 1))
+            for i, (first, last) in zip(members, per_field):
+                ol = dict(dims_actives[i])[d]
+                B = vb[i]
+                if _pair_emulated(B.dtype) and _is_tpu(grid):
+                    B, (first, last) = _materialize_planes(B, [first, last])
+                vb[i] = assemble_planes(B, {d: (first, last)},
+                                        [(d, ol)], plan=plans[i])
+    return vb
 
 
 # ---------------------------------------------------------------------------
@@ -471,20 +603,32 @@ def _assembly_plan(shape, dtype, dims, on_tpu: bool = False) -> str:
         selects never fuse — 1473 us — the round-4 superlinear grouped
         rows).
 
-    A lane-dim halo cannot avoid the select (per-lane DUS costs a full
-    relayout pass, 348 us; lane concat 920 us), so lane-ACTIVE f64 sets
-    keep the round-4 aligned-DUS/select plan — its 595 us/field at 256^3
-    sits at the pair-emulation floor (one fused select pass measures
-    322 us, split+combine+copies make up the rest; an all-select
-    single-fusion attempt and a DUS+select hybrid both measured ~600 us).
-    Halo sets that DON'T touch the lane dim get the 'dus64' plan: bare
-    plane DUSes for every active dim, nothing elementwise — 437 us vs
-    641 us per field at 256^3 x+y, and strictly linear in the field count
-    (4 fields 1765 us vs the superlinear 3243 us)."""
-    import numpy as np
+    The round-5 variant matrix sharpened the rule to two invariants:
 
-    if (on_tpu and np.dtype(dtype).itemsize >= 8
-            and (len(shape) - 1) not in dims):
+      1. Keep the pair graph HOMOGENEOUS.  All-DUS graphs are native
+         data movement and an all-select chain compiles to ONE fused
+         pass; MIXING the two poisons the program with defensive
+         pair-split copies (the xyz update as bare DUS x/y + one lane
+         select: 1314 us vs the 508 us all-select engine number; the
+         corner-patch form is matched to the plan by `_patch_form`).
+      2. Fence the planes (`_materialize_planes`): planes left as lazy
+         slices of the block being updated read-after-write-hazard the
+         whole buffer, and copy-insertion charges full-block copies per
+         loop iteration (x+y at 256^3: 441 us -> 58 us engine-measured
+         once fenced).
+
+    With both applied, lane-ACTIVE 8/16-byte sets take the all-'select'
+    plan (the only form that can touch the lane dim without a relayout:
+    per-lane DUS costs a full relayout pass, 348 us; lane concat 920 us;
+    barrier-fenced all-DUS incl. lane planes 930 us) and run at 508 us
+    for xyz at 256^3 — 2.50x the f32 writer pass for 2x the bytes, the
+    residual being while-loop carry copies the XLA:TPU buffer assigner
+    inserts for pair types (single-application compiles are copy-free).
+    Sets that DON'T touch the lane dim take the all-DUS 'dus64' plan:
+    58 us x+y at 256^3, 2.2x the f32 slab writers."""
+    if on_tpu and _pair_emulated(dtype):
+        if (len(shape) - 1) in dims:
+            return "select"
         return "dus64"
     slabs = _slab_sizes(shape, dtype)
     for d in dims:
@@ -520,21 +664,14 @@ def assemble_planes(out, recv: Dict, dims_active, plan: Optional[str] = None):
                             jnp.where(idx == s[d] - 1, recv[d][1], out))
         return out
     if plan == "dus64":
-        # Pair-emulated 8/16-byte dtypes (see `_assembly_plan`): bare plane
-        # DUSes for every non-lane dim (pure data movement under the x64/
-        # complex rewriter), one nested-select pass for the lane dim only.
-        # Dims ascend, so the lane pass runs last and wins the corners.
-        lane = len(s) - 1
+        # Pair-emulated 8/16-byte dtypes, lane dim NOT in the halo set
+        # (see `_assembly_plan`): bare plane DUSes only — pure data
+        # movement under the x64/complex rewriter, nothing elementwise.
         for d in dims:
             first, last = recv[d]
-            if d == lane:
-                idx = lax.broadcasted_iota(jnp.int32, s, d)
-                out = jnp.where(idx == 0, first,
-                                jnp.where(idx == s[d] - 1, last, out))
-            else:
-                out = lax.dynamic_update_slice_in_dim(out, first, 0, axis=d)
-                out = lax.dynamic_update_slice_in_dim(out, last, s[d] - 1,
-                                                      axis=d)
+            out = lax.dynamic_update_slice_in_dim(out, first, 0, axis=d)
+            out = lax.dynamic_update_slice_in_dim(out, last, s[d] - 1,
+                                                  axis=d)
         return out
 
     slabs = _slab_sizes(s, out.dtype)
@@ -604,18 +741,24 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
     from .ops.halo_write import halo_write_slabs, write_lane_active
 
     _check_assembly(assembly)
+    on_tpu = _is_tpu(grid)
     xla_plan = _assembly_plan(out.shape, out.dtype,
                               [d for d, _ in dims_active],
-                              on_tpu=_is_tpu(grid))
-    if assembly == "xla" or not (_is_tpu(grid) or _FORCE_WRITER_INTERPRET):
+                              on_tpu=on_tpu)
+
+    def xla_assemble(out, recv):
+        out, recv = _fence_recv(out, recv, dims_active, on_tpu)
+        return assemble_planes(out, recv, dims_active, plan=xla_plan)
+
+    if assembly == "xla" or not (on_tpu or _FORCE_WRITER_INTERPRET):
         if assembly == "pallas":
             raise GridError(_PALLAS_NEEDS_TPU)
-        return assemble_planes(out, recv, dims_active, plan=xla_plan)
+        return xla_assemble(out, recv)
     _, use_writer = _writer_dims(out, dims_active, grid)
     if not use_writer:
         if assembly == "pallas":
             raise GridError(_PALLAS_UNSUPPORTED)
-        return assemble_planes(out, recv, dims_active, plan=xla_plan)
+        return xla_assemble(out, recv)
     specs = [(d, "ext", jnp.squeeze(recv[d][0], d),
               jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
     interp = _FORCE_WRITER_INTERPRET
@@ -678,7 +821,7 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
     on_tpu = _is_tpu(grid)
     if assembly == "pallas" and not (on_tpu or _FORCE_WRITER_INTERPRET):
         raise GridError(_PALLAS_NEEDS_TPU)
-    shapes, sends, dims_moving, wraps, writer = [], [], [], [], []
+    shapes, dims_moving, wraps, writer = [], [], [], []
     for A in fields:
         s = A.shape
         dims = moving_dims(active_dims(s, grid), grid)
@@ -688,13 +831,49 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
                          else (frozenset(), False))
         if assembly == "pallas" and dims and not use_writer:
             raise GridError(_PALLAS_UNSUPPORTED)
-        # Send planes are needed for exchanged dims always, and for wrap
-        # dims only on the XLA path: the exchange never reads a wrap dim's
-        # sends, and the writer sources wrap halos itself (y/z from the
-        # block in VMEM, dim 0 from its own lazy slices).
+        dims_moving.append(dims)
+        writer.append(use_writer)
+        wraps.append(w if use_writer else frozenset())
+        shapes.append(s)
+
+    # XLA-plan fields whose halo set misses the lane dimension take the
+    # sequential per-dim form (free corner propagation, homogeneous
+    # pair-emulated graphs).  Lane-ACTIVE XLA fields stay on the grouped
+    # pre-extracted form below: their assembly is one fused select pass
+    # over the whole block, and sequential re-extraction would split it
+    # into one unfusable pass per dimension (measured 1367 vs 545 us for
+    # the f64 xyz update at 256^3).  Writer fields are grouped too — their
+    # assembly is an opaque kernel needing all planes up front.
+    seq_idx = [i for i in range(len(fields))
+               if not writer[i]
+               and not any(d == fields[i].ndim - 1
+                           for d, _ in dims_moving[i])]
+    seq_out: Dict[int, object] = {}
+    if seq_idx:
+        plans = [_assembly_plan(shapes[i], fields[i].dtype,
+                                [d for d, _ in dims_moving[i]],
+                                on_tpu=on_tpu) for i in seq_idx]
+        upd = exchange_assemble_sequential(
+            [fields[i] for i in seq_idx], [dims_moving[i] for i in seq_idx],
+            grid, plans)
+        seq_out = dict(zip(seq_idx, upd))
+    widx = [i for i in range(len(fields)) if writer[i] or i not in seq_out]
+    if not widx:
+        return tuple(seq_out[i] for i in range(len(fields)))
+
+    w_sends = []
+    for i in widx:
+        A = fields[i]
+        s = A.shape
+        dims = dims_moving[i]
+        w = wraps[i]
+        # Send planes are needed for exchanged dims only: the exchange
+        # never reads a wrap dim's sends, and the writer sources wrap
+        # halos itself (y/z from the block in VMEM, dim 0 from its own
+        # lazy slices).
         plane_req = {}
         for d, ol in dims:
-            if use_writer and d in w:
+            if d in w:
                 continue
             plane_req[(d, 0)] = (d, ol - 1)
             plane_req[(d, 1)] = (d, s[d] - ol)
@@ -712,22 +891,21 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
         for k, (d, pos) in plane_req.items():
             if k not in send:
                 send[k] = _plane(A, d, pos)
-        shapes.append(s)
-        sends.append(send)
-        dims_moving.append(dims)
-        wraps.append(w if use_writer else frozenset())
-        writer.append(use_writer)
+        w_sends.append(send)
 
-    recvs = exchange_all_dims_grouped(shapes, sends, dims_moving, grid,
-                                      wraps=wraps, blocks=fields)
+    recvs = exchange_all_dims_grouped(
+        [shapes[i] for i in widx], w_sends, [dims_moving[i] for i in widx],
+        grid, wraps=[wraps[i] for i in widx], blocks=[fields[i] for i in widx])
 
-    out = []
-    for i, A in enumerate(fields):
+    out = dict(seq_out)
+    for k, i in enumerate(widx):
+        A = fields[i]
         dims = dims_moving[i]
         if not writer[i]:
             plan = _assembly_plan(A.shape, A.dtype, [d for d, _ in dims],
                                   on_tpu=on_tpu)
-            out.append(assemble_planes(A, recvs[i], dims, plan=plan))
+            A, rv = _fence_recv(A, recvs[k], dims, on_tpu)
+            out[i] = assemble_planes(A, rv, dims, plan=plan)
             continue
         s = A.shape
         lane_active = any(d == A.ndim - 1 for d, _ in dims)
@@ -741,14 +919,14 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
                 else:
                     specs.append((d, "wrap", ol))
             else:
-                first, last = recvs[i][d]
+                first, last = recvs[k][d]
                 specs.append((d, "ext", jnp.squeeze(first, d),
                               jnp.squeeze(last, d)))
         interp = _FORCE_WRITER_INTERPRET
-        out.append(write_lane_active(A, specs, wraps[i], interpret=interp)
-                   if lane_active
-                   else halo_write_slabs(A, specs, interpret=interp))
-    return tuple(out)
+        out[i] = (write_lane_active(A, specs, wraps[i], interpret=interp)
+                  if lane_active
+                  else halo_write_slabs(A, specs, interpret=interp))
+    return tuple(out[i] for i in range(len(fields)))
 
 
 # ---------------------------------------------------------------------------

@@ -291,3 +291,69 @@ def test_hm3d_mega_matches_per_step_kernel():
         s = float(jnp.max(jnp.abs(b))) + 1e-30
         assert d / s < 1e-6, (name, d, s)
     igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+@pytest.mark.parametrize("periods", [(1, 1, 1), (1, 1, 0)])
+def test_f64_halo_oracle_on_chip(periods):
+    """Float64 (the reference's default element type) halo exchange on
+    real hardware: the barrier-fenced op-mix plans ('select' lane-active,
+    'dus64' otherwise — see igg.halo._assembly_plan) must reproduce the
+    reference's update semantics exactly, in the device representation.
+
+    The oracle encodes coordinates as small integers, which the x64
+    rewriter's float-float pairs represent exactly, so equality is
+    bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 64
+    with jax.enable_x64(True):
+        igg.init_global_grid(n, n, n, dimx=1, dimy=1, dimz=1,
+                             periodx=periods[0], periody=periods[1],
+                             periodz=periods[2], quiet=True)
+        i, j, k = np.meshgrid(np.arange(n), np.arange(n), np.arange(n),
+                              indexing="ij")
+        host = (i * n * n + j * n + k).astype(np.float64)
+
+        out = np.asarray(igg.update_halo(jnp.asarray(host)))
+
+        exp = host.copy()
+        for d in range(3):
+            if not periods[d]:
+                continue  # one open device: planes stay stale (no-write)
+            sl_first = [slice(None)] * 3
+            sl_last = [slice(None)] * 3
+            src_first = [slice(None)] * 3
+            src_last = [slice(None)] * 3
+            sl_first[d] = 0
+            src_first[d] = n - 2
+            sl_last[d] = n - 1
+            src_last[d] = 1
+            exp[tuple(sl_first)] = exp[tuple(src_first)]
+            exp[tuple(sl_last)] = exp[tuple(src_last)]
+        assert np.array_equal(out, exp), (
+            periods, np.argwhere(out != exp)[:5])
+        igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+@pytest.mark.parametrize("dtype", ["complex64", "complex128"])
+def test_complex_platform_envelope_on_chip(dtype):
+    """Pin the documented complex envelope (docs/migration.md): this
+    XLA:TPU toolchain rejects complex tensors outright (even creation —
+    'Element type C64/C128 is not supported on TPU'), so igg's complex
+    halo coverage runs on the CPU backend (tests/test_update_halo.py).
+    If a future toolchain accepts the creation below, this test will
+    fail — the signal to run the full complex oracle on chip and update
+    the envelope."""
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+
+    ctx = (jax.enable_x64(True) if dtype == "complex128"
+           else contextlib.nullcontext())
+    with ctx:
+        with pytest.raises(Exception, match="UNIMPLEMENTED|not supported"):
+            jax.block_until_ready(jnp.ones((8, 8), dtype))
