@@ -51,21 +51,27 @@ import numpy as np
 from common import emit, median_of, note, time_dispatches
 
 
-def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
+def bench(local_shape, nfields: int, dtype, *, nt: int, n_inner: int):
+    """Seconds per grouped `update_halo_local` of `nfields` blocks of any
+    rank, plus the effective GB/s over the logical halo bytes (4 planes
+    per field per moving dimension)."""
+    import math
+
     import jax
     from jax import lax
 
     import igg
 
     grid = igg.get_global_grid()
+    local_shape = tuple(local_shape)
 
     def mkfields():
         # Fresh arrays per measurement: the update donates its inputs, so a
         # previous rep's fields are consumed buffers.
-        return tuple(igg.zeros((n, n, n), dtype=dtype) + i
+        return tuple(igg.zeros(local_shape, dtype=dtype) + i
                      for i in range(nfields))
 
-    spec = igg.spec_for(3)
+    spec = igg.spec_for(len(local_shape))
 
     def body(*fs):
         def it(_, fs):
@@ -80,11 +86,12 @@ def bench(n: int, nfields: int, dtype, *, nt: int, n_inner: int):
     sec = median_of(lambda: time_dispatches(fn, mkfields(), nt=nt)) / n_inner
 
     from igg.halo import active_dims, moving_dims
-    ndims = len(moving_dims(active_dims((n, n, n), grid), grid))
+    moving = moving_dims(active_dims(local_shape, grid), grid)
     itemsize = np.dtype(dtype).itemsize
-    plane_bytes = n * n * itemsize
-    bytes_moved = nfields * ndims * 4 * plane_bytes
-    return sec, bytes_moved / sec / 1e9, ndims
+    cells = math.prod(local_shape)
+    bytes_moved = sum(nfields * 4 * (cells // local_shape[d]) * itemsize
+                      for d, _ in moving)
+    return sec, bytes_moved / sec / 1e9, len(moving)
 
 
 def main():
@@ -123,8 +130,8 @@ def main():
                        if np.dtype(dtype).itemsize == 8
                        else contextlib.nullcontext())
                 with ctx:
-                    sec, gbps, ndims = bench(n, nfields, dtype, nt=nt,
-                                             n_inner=n_inner)
+                    sec, gbps, ndims = bench((n, n, n), nfields, dtype,
+                                             nt=nt, n_inner=n_inner)
                 emit({
                     "metric": "halo_exchange_bandwidth_per_chip",
                     "value": round(gbps, 2),
@@ -138,6 +145,41 @@ def main():
                     "us_per_update": round(sec * 1e6, 2),
                 })
         igg.finalize_global_grid()
+
+    # Rank-2 fields (wave2d-class problems), through the same harness.
+    # The engine routes them to the XLA plans (rank-3-only Pallas
+    # writers don't apply); round 5 measured them at the slope-timer
+    # noise floor — 5-47 us for 1-3 fields at 256^2 across
+    # f32/bf16/f64, within ~2x the rank-3 slab-write analogs — and in
+    # the real 2-D model the cost is noise (wave2d leapfrog at 4096^2
+    # f32: 1.375 ms/step, bandwidth-bound over its 3-field two-pass
+    # traffic, vs ~45 us for its grouped 3-field exchange, ~3%).  The
+    # rows exist so a layout-lottery regression on a future toolchain
+    # shows up in the artifact diff.
+    igg.init_global_grid(n, n, 3, periodx=1, periody=1, quiet=True)
+    grid = igg.get_global_grid()
+    note(f"rank-2 section: local={n}^2, fields 1/3")
+    for nfields in (1, 3):
+        for dtype in dtypes:
+            ctx = (jax.enable_x64(True)
+                   if np.dtype(dtype).itemsize == 8
+                   else contextlib.nullcontext())
+            with ctx:
+                sec, gbps, _ = bench((n, n), nfields, dtype, nt=nt,
+                                     n_inner=n_inner)
+            emit({
+                "metric": "halo_exchange_bandwidth_per_chip",
+                "value": round(gbps, 2),
+                "unit": "GB/s",
+                "config": {"local": n, "fields": nfields,
+                           "dtype": np.dtype(dtype).name,
+                           "halo_dims": "xy", "ndims": 2, "rank": 2,
+                           "devices": grid.nprocs,
+                           "dims": list(grid.dims),
+                           "platform": platform},
+                "us_per_update": round(sec * 1e6, 2),
+            })
+    igg.finalize_global_grid()
 
 
 if __name__ == "__main__":

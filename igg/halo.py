@@ -515,6 +515,7 @@ def exchange_assemble_sequential(fields, dims_actives, grid, plans):
     opaque kernel that needs all planes materialized up front."""
     nf = len(fields)
     vb = list(fields)
+    on_tpu = _is_tpu(grid)
     all_dims = sorted({d for da in dims_actives for d, _ in da})
     for d in all_dims:
         fidx = [i for i in range(nf) if d in dict(dims_actives[i])]
@@ -541,11 +542,9 @@ def exchange_assemble_sequential(fields, dims_actives, grid, plans):
                                        periodic, getattr(grid, "disp", 1))
             for i, (first, last) in zip(members, per_field):
                 ol = dict(dims_actives[i])[d]
-                B = vb[i]
-                if _pair_emulated(B.dtype) and _is_tpu(grid):
-                    B, (first, last) = _materialize_planes(B, [first, last])
-                vb[i] = assemble_planes(B, {d: (first, last)},
-                                        [(d, ol)], plan=plans[i])
+                B, rv = _fence_recv(vb[i], {d: (first, last)}, [(d, ol)],
+                                    on_tpu)
+                vb[i] = assemble_planes(B, rv, [(d, ol)], plan=plans[i])
     return vb
 
 
