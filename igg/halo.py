@@ -753,7 +753,7 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
         if assembly == "pallas":
             raise GridError(_PALLAS_NEEDS_TPU)
         return xla_assemble(out, recv)
-    _, use_writer = _writer_dims(out, dims_active, grid)
+    _, use_writer = _writer_dims(out, dims_active, grid, all_ext=True)
     if not use_writer:
         if assembly == "pallas":
             raise GridError(_PALLAS_UNSUPPORTED)
@@ -766,7 +766,7 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
     return halo_write_slabs(out, specs, interpret=interp)
 
 
-def _writer_dims(A, dims, grid):
+def _writer_dims(A, dims, grid, all_ext: bool = False):
     """Partition a field's moving dims for the one-pass Pallas writer path:
     returns `(wraps, use_writer)` where `wraps` are the single-device
     periodic dims whose halos the writer assembles from in-VMEM self-wrap
@@ -783,7 +783,8 @@ def _writer_dims(A, dims, grid):
     picks aligned-DUS for tile-aligned shapes (masked-select otherwise),
     the reference-default-Float64 story of VERDICT r3 item 4's fallback
     clause."""
-    from .ops.halo_write import halo_write_supported, slab_write_supported
+    from .ops.halo_write import (ext_planes_supported, halo_write_supported,
+                                 slab_write_supported)
 
     wraps = frozenset(d for d, _ in dims
                       if grid.dims[d] == 1 and grid.periods[d])
@@ -795,6 +796,14 @@ def _writer_dims(A, dims, grid):
                       and _assembly_plan(A.shape, A.dtype, dd) != "select")
     else:
         use_writer = slab_write_supported(A.shape, A.dtype, dd, interp)
+    # Received (ext) planes ride partial-grid BlockSpecs with Mosaic
+    # tile-alignment requirements; self-wrap planes never materialize and
+    # dim-0 planes are passed whole (`ext_planes_supported`).  With
+    # `all_ext` (assemble_field: every plane arrives dense) wrap dims
+    # count as ext too.
+    ext_dims = [d for d in dd if d != 0 and (all_ext or d not in wraps)]
+    if use_writer and not interp:
+        use_writer = ext_planes_supported(A.shape, A.dtype, ext_dims)
     return wraps, use_writer
 
 

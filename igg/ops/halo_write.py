@@ -153,6 +153,50 @@ def _dtype_ok(dtype, interpret: bool) -> bool:
     return True
 
 
+def ext_planes_supported(shape, dtype, ext_dims) -> bool:
+    """Whether Mosaic accepts the writers' partial-grid BlockSpecs for the
+    received (ext) planes of `ext_dims`: a plane array's own trailing dim
+    must be 128-lane aligned when the writer tiles it with a partial
+    `(bx, .)` block — dim-1 planes are `(n0, n2)` cut as `(bx, n2)` and
+    dim-2 planes `(n0, n1)` cut as `(bx, n1)` ("last two dimensions of
+    your block shape [must be] divisible by 8 and 128 respectively, or be
+    equal to the full array dims").  Dim-0 planes are passed whole and are
+    exempt, as is the whole field when `bx == n0` (full-block specs).
+    Staggered fields (`n+1` extents) with exchanged sublane/lane dims fail
+    this — caught by the round-5 v5p-64 AOT schedule study, where the
+    Stokes overlap program crashed Mosaic lowering — and take the XLA
+    plans instead."""
+    import numpy as np
+
+    n0, n1, n2 = shape
+    if not any(d in ext_dims for d in (1, 2)):
+        return True
+    itemsize = np.dtype(dtype).itemsize
+    ts = _sublane_tile(itemsize)
+
+    def bx_ok(bx):
+        # Partial `(bx, .)` plane blocks put bx on the block's sublane dim
+        # (staggered/odd n0 degrades bx to 1 — the Stokes Vx case); a
+        # block equal to the full plane is always accepted.
+        return bx == n0 or bx % ts == 0
+
+    ok = True
+    if 1 in ext_dims:
+        ok = ok and n2 % 128 == 0 and bx_ok(_pick_bx(n0, n1, n2, itemsize))
+    if 2 in ext_dims:
+        # The exchanged-lane write runs `_write_dim2` (bx picked against a
+        # 128-lane column) when the dirty-column conditions hold, the
+        # one-pass writer (bx against the full block) otherwise — mirror
+        # that dispatch exactly (`write_lane_active`).
+        col = (n2 % 128 == 0 and n2 >= 3 * 128
+               and slab_write_supported(shape, dtype,
+                                        [d for d in ext_dims if d != 2]))
+        bx2 = (_pick_bx(n0, n1, 128, itemsize) if col
+               else _pick_bx(n0, n1, n2, itemsize))
+        ok = ok and n1 % 128 == 0 and bx_ok(bx2)
+    return ok
+
+
 def halo_write_supported(shape, dtype, interpret: bool = False) -> bool:
     """The writer handles rank-3 blocks of >= 16-bit elements (16-bit lane
     expansion round-trips exactly through f32; 64-bit non-complex through
