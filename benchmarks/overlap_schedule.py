@@ -71,9 +71,10 @@ _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "u32": 4,
                 "s32": 4, "u8": 1, "pred": 1}
 
 
-def _init_grid(n, topo, want_dims=None, **grid_kwargs):
+def _init_grid(n, topo, **grid_kwargs):
     import igg
 
+    want_dims = getattr(topo, "igg_want_dims", None)
     igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
                          quiet=True, devices=list(topo.devices),
                          **grid_kwargs)
@@ -109,7 +110,7 @@ def compile_diffusion(n, topo):
     import igg
     from igg.models import diffusion3d as d3
 
-    grid = _init_grid(n, topo, getattr(topo, 'igg_want_dims', None))
+    grid = _init_grid(n, topo)
     dims = grid.dims
     params = d3.Params()
     dx, dy, dz = params.spacing()
@@ -131,8 +132,7 @@ def compile_stokes(n, topo):
     import igg
     from igg.models import stokes3d
 
-    grid = _init_grid(n, topo, getattr(topo, 'igg_want_dims', None),
-                      overlapx=3, overlapy=3, overlapz=3)
+    grid = _init_grid(n, topo, overlapx=3, overlapy=3, overlapz=3)
     dims = grid.dims
     kw = stokes3d._pseudo_steps(stokes3d.Params())
 
@@ -155,7 +155,7 @@ def compile_hm3d(n, topo):
     import igg
     from igg.models import hm3d
 
-    grid = _init_grid(n, topo, getattr(topo, 'igg_want_dims', None))
+    grid = _init_grid(n, topo)
     dims = grid.dims
     params = hm3d.Params()
     dx, dy, dz = params.spacing()
@@ -178,7 +178,7 @@ def compile_trapezoid(n, topo, n_inner=17, bx=8):
     import igg
     from igg.ops import fused_diffusion_steps
 
-    grid = _init_grid(n, topo, getattr(topo, 'igg_want_dims', None))
+    grid = _init_grid(n, topo)
     dims = grid.dims
     from igg.models import diffusion3d as d3
 

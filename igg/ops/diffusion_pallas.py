@@ -344,6 +344,15 @@ def _self_wrap_all(grid) -> bool:
             and all(bool(p) for p in grid.periods))
 
 
+def _single_device_modes(grid):
+    """Per-dim mega-kernel halo modes for a 1-device grid ("wrap" periodic
+    self-neighbor / "frozen" open no-write — `diffusion_mega` docstring),
+    or None when any dimension is split across devices."""
+    if tuple(grid.dims) != (1, 1, 1):
+        return None
+    return tuple("wrap" if grid.periods[d] else "frozen" for d in range(3))
+
+
 def _sends_and_stale(T, a_slabs, slabs, scal, wrap_yz):
     """Squeezed send planes (updated inner planes `ol-1`/`s-ol`) from compact
     boundary slabs, plus stale (outermost) planes for open-boundary dims — no
@@ -461,14 +470,17 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
     A = float(dt * lam) / Cp   # loop-invariant coefficient (no in-loop divide)
     wrap_yz = _wrap_dims(grid)
 
-    if _self_wrap_all(grid):
+    modes = _single_device_modes(grid)
+    if modes is not None:
         from .diffusion_mega import fused_diffusion_megasteps, mega_supported
 
-        # Fastest: the whole inner loop as ONE pallas_call with the
-        # coefficient array resident in VMEM (see `diffusion_mega`).
+        # Fastest: the whole inner loop as ONE pallas_call, coefficient
+        # VMEM-resident when it fits and slab-streamed otherwise; open
+        # dims run the frozen-edge mode (see `diffusion_mega` — this is
+        # the reference's published 510^3 open-boundary headline path).
         if mega_supported(T.shape, bx, n_inner, interpret, dtype=T.dtype):
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
-                                             **scal)
+                                             **scal, modes=modes)
 
     # Exchanged fully-periodic meshes — (N,1,1)/(N,M,1)/(N,M,K) rings and
     # tori, self-wrapped or extended per dim: K-step trapezoidal chunks,

@@ -40,13 +40,22 @@ Per-chunk overhead vs the ideal: the concat (one extended-buffer write) and
 the redundant shoulder compute (`2K/S` per extended dim) — both amortized
 by K.
 
-Validity requires every device to have both neighbors along each extended
-dimension, i.e. fully periodic rings along all three dims (`periods[d]`,
-any `dims[d] >= 1` — on one device a ring is the self-neighbor ppermute,
-handled by the in-kernel wrap, and the path is exercised end-to-end on a
-single chip).  Open boundaries keep the per-step path: their no-write halo
-semantics (`/root/reference/test/test_update_halo.jl:727-732`) would need
-per-device shape differences that SPMD programs cannot express.  The dispatcher in
+Validity needs fresh data beyond each extended end.  Periodic rings
+provide it by neighbor slabs (`periods[d]`, any `dims[d] >= 1` — on one
+device a ring is the self-neighbor ppermute, handled by the in-kernel
+wrap).  OPEN boundaries (round 5) provide it by freezing: a no-write
+boundary row (`/root/reference/test/test_update_halo.jl:727-732`) is
+genuinely local — global-edge devices re-freeze their boundary slab from
+the chunk-entry buffer every step (uniform SPMD shapes, `axis_index`
+masks), which both preserves the frozen rows bit-for-bit and quarantines
+the beyond-domain shoulder garbage, so the validity front never shrinks
+from an open side.  The open modes (`_dim_modes`: "oext"/"frozen") are
+realized by the pure-XLA window path and pinned per-step-equivalent on
+open and mixed meshes by `tests/test_trapezoid.py::test_open_*`; the
+Mosaic chunk kernel implements the periodic modes only (per-device
+edge-freezing inside the manual-DMA pipeline is future work), so the
+compiled dispatcher keeps the per-step kernel on open grids
+(`trapezoid_supported(allow_open=False)` default).  The dispatcher in
 `fused_diffusion_steps` also runs one per-step kernel step BEFORE the
 chunks, which consumes never-exchanged entry halos exactly like every
 other path (bit-equivalence for ANY input).
@@ -65,23 +74,54 @@ from .diffusion_mega import _VMEM_BUDGET
 from .diffusion_pallas import _u_rows
 
 
-def _mode(grid):
-    """(ok, y_ext, z_ext) — every dimension must be a periodic ring; y/z
-    are either self-wraps (1 periodic device) or extended periodic rings.
-    Covers the full `(N,M,K)` 3-D torus (the v5p BASELINE topology)."""
-    if not all(bool(p) for p in grid.periods):
-        return False, False, False
-    return True, grid.dims[1] > 1, grid.dims[2] > 1
+def _dim_modes(grid, force_y_ext=None, force_z_ext=None):
+    """Per-dimension window mode for the chunk evolution:
+
+      - ``"ext"``    periodic ring, K-extended by ppermute slabs (x is
+                     always extended when periodic — on one device the
+                     self-neighbor slabs are local wrap values);
+      - ``"wrap"``   periodic single device, y/z in-buffer self-wrap;
+      - ``"oext"``   open with >1 devices: extended like "ext" but with
+                     non-wrapping permutes, and the GLOBAL-edge devices
+                     re-freeze their boundary slab every step (the
+                     reference's no-write halo semantics,
+                     `/root/reference/test/test_update_halo.jl:727-732` —
+                     a frozen boundary row is genuinely local, so the
+                     validity front never shrinks from that side);
+      - ``"frozen"`` open single device: no extension, both edge rows
+                     re-frozen every step on every device.
+
+    The Mosaic chunk kernel implements only the periodic modes; the open
+    modes run in the pure-XLA window realization (see
+    `trapezoid_supported(allow_open=...)`)."""
+    modes = []
+    for d in range(3):
+        if grid.periods[d]:
+            modes.append("ext" if (d == 0 or grid.dims[d] > 1) else "wrap")
+        else:
+            modes.append("oext" if grid.dims[d] > 1 else "frozen")
+    if force_y_ext is not None:
+        modes[1] = "ext" if force_y_ext else "wrap"
+    if force_z_ext is not None:
+        modes[2] = "ext" if force_z_ext else "wrap"
+    return tuple(modes)
 
 
 def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
-                        force_y_ext=None, force_z_ext=None) -> bool:
-    """Whether the K=bx trapezoidal chunk path applies: fully-periodic
-    rings along every dimension (self-wrap or extended), at least one full
+                        force_y_ext=None, force_z_ext=None,
+                        allow_open: bool = False) -> bool:
+    """Whether the K=bx trapezoidal chunk path applies: periodic rings
+    along every dimension (self-wrap or extended), at least one full
     chunk, the K-slab sends must lie inside the block, and the extended
     coefficient plus working buffers must fit in VMEM (the interpret-mode
     XLA fallback obeys the same gates so both modes take the same
-    route)."""
+    route).
+
+    `allow_open=True` additionally admits open (non-periodic) dimensions
+    — the "oext"/"frozen" window modes of `_dim_modes`, realized only by
+    the pure-XLA window path (`interpret=True`); the Mosaic kernel has no
+    per-device edge-freezing masks, so the compiled dispatcher keeps the
+    per-step kernel on open grids."""
     import numpy as np
 
     if n_inner < bx or bx < 2:
@@ -91,19 +131,18 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         # (`_extend_dim`); disp > 1 grids take the per-step path, whose
         # engine-level exchange honors `grid.disp`.
         return False
-    ok, y_ext, z_ext = _mode(grid)
-    if not ok:
+    modes = _dim_modes(grid, force_y_ext, force_z_ext)
+    if not allow_open and any(m in ("oext", "frozen") for m in modes):
         return False
-    if force_y_ext is not None:
-        y_ext = force_y_ext
-    if force_z_ext is not None:
-        z_ext = force_z_ext
+    y_ext = modes[1] in ("ext", "oext")
+    z_ext = modes[2] in ("ext", "oext")
     S0, S1, S2 = shape
     K = bx
     olx = grid.ol_of_local(0, shape)
     if olx < 2 or S0 % bx != 0:
         return False
-    if S0 - olx - K < 0 or olx + K > S0:  # x send slabs inside the block
+    if modes[0] != "frozen" and (S0 - olx - K < 0 or olx + K > S0):
+        # x send slabs inside the block (no slabs in frozen mode)
         return False
     if S1 % 8 != 0:
         # Mosaic requires tile-aligned VMEM memref slices of the double-
@@ -138,7 +177,7 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         if S2 - olz - K < 0 or olz + K > S2:
             return False
         S2e = ((S2 + 2 * K + 127) // 128) * 128
-    S0e = S0 + 2 * K
+    S0e = S0 + (2 * K if modes[0] != "frozen" else 0)
     itemsize = np.dtype(dtype).itemsize
     need = itemsize * (S0e * S1e * S2e            # A_ext resident
                        + 2 * (bx + 2) * S1e * S2e   # ext slabs (dbl-buffered)
@@ -287,31 +326,57 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
         pltpu.make_async_copy(o2.at[sl], o2.at[sl], osems.at[sl]).wait()
 
 
-def _window_steps_xla(Text, A_ext, *, K, y_ext, z_ext, rdx2, rdy2, rdz2):
+def _window_steps_xla(Text, A_ext, *, K, modes, grid, rdx2, rdy2, rdz2):
     """Pure-XLA realization of the chunk kernel's per-step update (interior
     x rows; y/z wrap or extended) — the interpret-mode fallback so CPU
     meshes and the driver dryrun exercise the SAME chunked-exchange
     /shrinking-validity structure the TPU kernel runs (the kernel itself is
-    manual-DMA and has no interpret mode)."""
+    manual-DMA and has no interpret mode).  This realization additionally
+    carries the open-boundary modes (`_dim_modes`): after each step, open
+    global-edge devices re-freeze their boundary slab from the chunk-entry
+    buffer — the no-write halo semantics — which both preserves the
+    reference's frozen boundary rows bit-for-bit and quarantines the
+    garbage in the beyond-domain shoulder rows (a frozen row is never
+    recomputed, so nothing beyond it is ever read by a valid row)."""
+    import jax.numpy as jnp
     from jax import lax
+
+    from ..shared import AXIS_NAMES
+
+    F = Text   # chunk-entry values: the freeze source for open edges
 
     def step(_, U):
         S1e, S2 = U.shape[1], U.shape[2]
         U = U.at[1:-1, 1:-1, 1:-1].set(
             _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1],
                     rdx2=rdx2, rdy2=rdy2, rdz2=rdz2))
-        if not y_ext:
+        if modes[1] == "wrap":
             U = U.at[:, 0, 1:-1].set(U[:, S1e - 2, 1:-1])
             U = U.at[:, S1e - 1, 1:-1].set(U[:, 1, 1:-1])
-        if not z_ext:
+        if modes[2] == "wrap":
             U = U.at[:, :, 0].set(U[:, :, S2 - 2])
             U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+        for d in range(3):
+            Sd = U.shape[d]
+            if modes[d] == "frozen":
+                lo = [slice(None)] * 3
+                hi = [slice(None)] * 3
+                lo[d] = slice(0, 1)
+                hi[d] = slice(Sd - 1, Sd)
+                U = U.at[tuple(lo)].set(F[tuple(lo)])
+                U = U.at[tuple(hi)].set(F[tuple(hi)])
+            elif modes[d] == "oext":
+                idx = lax.broadcasted_iota(jnp.int32, U.shape, d)
+                ai = lax.axis_index(AXIS_NAMES[d])
+                U = jnp.where((ai == 0) & (idx <= K), F, U)
+                U = jnp.where((ai == grid.dims[d] - 1)
+                              & (idx >= Sd - 1 - K), F, U)
         return U
 
     return lax.fori_loop(0, K, step, Text)
 
 
-def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, z_ext,
+def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
                 rdx2, rdy2, rdz2, interpret=False):
     """Advance K steps on the extended buffer; returns the central
     `out_shape3` window."""
@@ -322,15 +387,18 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, z_ext,
 
     S0e, S1e, S2e = Text.shape
     S0, S1o, S2o = out_shape3
+    extended = [m in ("ext", "oext") for m in modes]
     if interpret:
-        out = _window_steps_xla(Text, A_ext, K=K, y_ext=y_ext, z_ext=z_ext,
+        out = _window_steps_xla(Text, A_ext, K=K, modes=modes, grid=grid,
                                 rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
-        out = lax.slice_in_dim(out, K, K + S0, axis=0)
-        if y_ext:
-            out = lax.slice_in_dim(out, K, K + S1o, axis=1)
-        if z_ext:
-            out = lax.slice_in_dim(out, K, K + S2o, axis=2)
+        for d, (ext, So) in enumerate(zip(extended, (S0, S1o, S2o))):
+            if ext:
+                out = lax.slice_in_dim(out, K, K + So, axis=d)
         return out
+    assert modes[0] == "ext" and not any(m in ("oext", "frozen")
+                                         for m in modes), (
+        "the Mosaic chunk kernel implements only the periodic modes")
+    y_ext, z_ext = extended[1], extended[2]
     if z_ext and S2e % 128 != 0:
         # Mosaic requires 128-aligned VMEM lane slices; right-pad the
         # extended lane extent with zeros.  The garbage lanes lie beyond
@@ -391,7 +459,7 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, y_ext, z_ext,
     return out
 
 
-def _extend_dim(T, K, ol, grid, d):
+def _extend_dim(T, K, ol, grid, d, mode: str = "ext"):
     """The `size + 2K` contiguous global window along dim `d`: K extension
     rows beyond each end PLUS neighbor-fresh values for the block's own
     halo rows, all from one ppermute pair of `(K+1)`-row slabs
@@ -416,13 +484,18 @@ def _extend_dim(T, K, ol, grid, d):
     S = T.shape[d]
     n = grid.dims[d]
     axis = AXIS_NAMES[d]
+    open_edges = mode == "oext"
     # rows [S-ol-K, S-ol]: K extension rows + the halo value for the
     # next neighbor's row 0; rows [ol-1, ol+K): ditto mirrored.
     left_slab = lax.slice_in_dim(T, S - ol - K, S - ol + 1, axis=d)
     right_slab = lax.slice_in_dim(T, ol - 1, ol + K, axis=d)
     if n > 1:
-        to_right = [(i, (i + 1) % n) for i in range(n)]
-        to_left = [(i, (i - 1) % n) for i in range(n)]
+        if open_edges:
+            to_right = [(i, i + 1) for i in range(n - 1)]
+            to_left = [(i, i - 1) for i in range(1, n)]
+        else:
+            to_right = [(i, (i + 1) % n) for i in range(n)]
+            to_left = [(i, (i - 1) % n) for i in range(n)]
         tw = d == 2 and T.ndim == 3   # transpose-carried lane-dim slabs
         if tw:
             left_slab = jnp.swapaxes(left_slab, 1, 2)
@@ -432,21 +505,36 @@ def _extend_dim(T, K, ol, grid, d):
         if tw:
             left_slab = jnp.swapaxes(left_slab, 1, 2)
             right_slab = jnp.swapaxes(right_slab, 1, 2)
-    return jnp.concatenate(
+    Text = jnp.concatenate(
         [left_slab, lax.slice_in_dim(T, 1, S - 1, axis=d), right_slab],
         axis=d)
+    if open_edges:
+        # Global-edge devices received zeros: rows [0, K) / [Se-K, Se) lie
+        # beyond the domain (garbage the step-level freeze quarantines),
+        # but ext row K / Se-1-K replaced the block's own boundary rows —
+        # restore their no-write (stale) values there.
+        idx = lax.axis_index(axis)
+        Se = S + 2 * K
+        fixed_l = lax.dynamic_update_slice_in_dim(
+            Text, lax.slice_in_dim(T, 0, 1, axis=d), K, axis=d)
+        Text = jnp.where(idx == 0, fixed_l, Text)
+        fixed_r = lax.dynamic_update_slice_in_dim(
+            Text, lax.slice_in_dim(T, S - 1, S, axis=d), Se - 1 - K, axis=d)
+        Text = jnp.where(idx == n - 1, fixed_r, Text)
+    return Text
 
 
-def _extend(T, K, grid, shape, y_ext, z_ext):
+def _extend(T, K, grid, shape, modes):
     """x extension, then (for split y/z) the y extension OF the x-extended
     buffer and the z extension of the x/y-extended buffer — corner and edge
     regions arrive via the later neighbors' own earlier-dim extensions (the
-    sequential-exchange corner trick)."""
-    Text = _extend_dim(T, K, grid.ol_of_local(0, shape), grid, 0)
-    if y_ext:
-        Text = _extend_dim(Text, K, grid.ol_of_local(1, shape), grid, 1)
-    if z_ext:
-        Text = _extend_dim(Text, K, grid.ol_of_local(2, shape), grid, 2)
+    sequential-exchange corner trick).  "wrap"/"frozen" dims are not
+    extended (in-buffer self-wrap / frozen edges)."""
+    Text = T
+    for d in range(3):
+        if modes[d] in ("ext", "oext"):
+            Text = _extend_dim(Text, K, grid.ol_of_local(d, shape), grid,
+                               d, modes[d])
     return Text
 
 
@@ -463,18 +551,14 @@ def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
 
     K = bx
     shape = T.shape
-    _, y_ext, z_ext = _mode(grid)
-    if force_y_ext is not None:
-        y_ext = force_y_ext
-    if force_z_ext is not None:
-        z_ext = force_z_ext
+    modes = _dim_modes(grid, force_y_ext, force_z_ext)
     chunks = n_inner // K
-    A_ext = _extend(A, K, grid, shape, y_ext, z_ext)   # loop-invariant
+    A_ext = _extend(A, K, grid, shape, modes)   # loop-invariant
 
     def one(_, T):
-        Text = _extend(T, K, grid, shape, y_ext, z_ext)
-        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, y_ext=y_ext,
-                           z_ext=z_ext, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
+        Text = _extend(T, K, grid, shape, modes)
+        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, modes=modes,
+                           grid=grid, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
                            interpret=interpret)
 
     T = lax.fori_loop(0, chunks, one, T)

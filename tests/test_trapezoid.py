@@ -101,12 +101,12 @@ def _window_steps_2d(Text, A_ext, K, scal):
 def test_window_chunk_matches_per_step_on_torus():
     """(N,M,1) mesh: x and y both extended (corners via the y-neighbor's
     own x extension); compared against per-step [stencil + update_halo]."""
-    from igg.ops.diffusion_trapezoid import _extend, _mode
+    from igg.ops.diffusion_trapezoid import _dim_modes, _extend
 
     igg.init_global_grid(12, 12, 8, dimx=4, dimy=2, dimz=1,
                          periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
-    assert _mode(grid) == (True, True, False)
+    assert _dim_modes(grid) == ("ext", "ext", "wrap")
     K = 4
     scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
 
@@ -120,8 +120,8 @@ def test_window_chunk_matches_per_step_on_torus():
 
     @igg.sharded
     def chunk(T, A):
-        A_ext = _extend(A, K, grid, T.shape, True, False)
-        Text = _extend(T, K, grid, T.shape, True, False)
+        A_ext = _extend(A, K, grid, T.shape, ("ext", "ext", "wrap"))
+        Text = _extend(T, K, grid, T.shape, ("ext", "ext", "wrap"))
         out = _window_steps_2d(Text, A_ext, K, scal)
         return out[K:K + T.shape[0], K:K + T.shape[1]]
 
@@ -161,12 +161,12 @@ def test_window_chunk_matches_per_step_on_3d_torus():
     extended (edges/corners via the later neighbors' earlier-dim
     extensions; z slabs transpose-carried on the wire) — against per-step
     [stencil + update_halo]."""
-    from igg.ops.diffusion_trapezoid import _extend, _mode
+    from igg.ops.diffusion_trapezoid import _dim_modes, _extend
 
     igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
                          periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
-    assert _mode(grid) == (True, True, True)
+    assert _dim_modes(grid) == ("ext", "ext", "ext")
     K = 4
     scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
 
@@ -180,8 +180,8 @@ def test_window_chunk_matches_per_step_on_3d_torus():
 
     @igg.sharded
     def chunk(T, A):
-        A_ext = _extend(A, K, grid, T.shape, True, True)
-        Text = _extend(T, K, grid, T.shape, True, True)
+        A_ext = _extend(A, K, grid, T.shape, ("ext", "ext", "ext"))
+        Text = _extend(T, K, grid, T.shape, ("ext", "ext", "ext"))
         out = _window_steps_3d(Text, A_ext, K, scal)
         return out[K:K + T.shape[0], K:K + T.shape[1], K:K + T.shape[2]]
 
@@ -233,12 +233,12 @@ def test_model_path_interpret_n1k():
     mode combination the torus tests don't reach."""
     import igg
     from igg.models import diffusion3d as d3
-    from igg.ops.diffusion_trapezoid import _mode, trapezoid_supported
+    from igg.ops.diffusion_trapezoid import _dim_modes, trapezoid_supported
 
     igg.init_global_grid(16, 16, 128, dimx=4, dimy=1, dimz=2,
                          periodx=1, periody=1, periodz=1, quiet=True)
     grid = igg.get_global_grid()
-    assert _mode(grid) == (True, False, True)
+    assert _dim_modes(grid) == ("ext", "wrap", "ext")
     params = d3.Params(lx=8.0, ly=8.0, lz=60.0)
     T, Cp = d3.init_fields(params, dtype=np.float32)
     n_inner = 9
@@ -284,3 +284,76 @@ def test_model_path_interpret_ring():
     out = np.asarray(pal_step(T, Cp), np.float64)
     scale = max(abs(ref).max(), 1e-30)
     assert abs(out - ref).max() <= 4e-6 * scale
+
+
+def _chunk_vs_per_step_open(mesh, periods, K=8, shape=(16, 16, 128)):
+    """Shared driver: one K-chunk of the open-boundary window realization
+    (`fused_diffusion_trapezoid_steps(interpret=True)`) against K per-step
+    [stencil + update_halo] applications, from an exchange-fresh state."""
+    from jax import lax
+
+    from igg.ops.diffusion_trapezoid import (_dim_modes,
+                                             fused_diffusion_trapezoid_steps,
+                                             trapezoid_supported)
+
+    igg.init_global_grid(shape[0], shape[1], shape[2],
+                         dimx=mesh[0], dimy=mesh[1], dimz=mesh[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    grid = igg.get_global_grid()
+    scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
+    assert trapezoid_supported(grid, shape, K, K, np.float32,
+                               allow_open=True)
+    assert not trapezoid_supported(grid, shape, K, K, np.float32)
+
+    rng = np.random.default_rng(29)
+    T0 = igg.from_local_blocks(
+        lambda coords, ls: rng.standard_normal(ls) + 10.0 * coords[0]
+        + 100.0 * coords[1] + 1000.0 * coords[2], shape)
+    A0 = igg.from_local_blocks(
+        lambda coords, ls: 0.05 + 0.01 * rng.random(ls), shape)
+    T0, A0 = igg.update_halo(T0, A0)   # exchange-fresh chunk entry
+
+    @igg.sharded
+    def chunk(T, A):
+        out, done = fused_diffusion_trapezoid_steps(
+            T, A, n_inner=K, bx=K, grid=grid, **scal, interpret=True)
+        return out
+
+    @igg.sharded
+    def per_step(T, A):
+        def one(_, T):
+            T = T.at[1:-1, 1:-1, 1:-1].set(
+                _u_rows(T[:-2], T[1:-1], T[2:], A[1:-1], **scal))
+            return igg.update_halo_local(T)
+
+        return lax.fori_loop(0, K, one, T)
+
+    out = np.asarray(chunk(T0, A0))
+    ref = np.asarray(per_step(T0, A0))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-12)
+    igg.finalize_global_grid()
+    return _dim_modes(grid)
+
+
+def test_open_x_window_chunk():
+    """Open x over 8 devices, y/z open single (frozen edges): the 'oext'
+    freeze masks must reproduce the per-step no-write halo semantics
+    (`/root/reference/test/test_update_halo.jl:727-732`) exactly."""
+    modes = _chunk_vs_per_step_open((8, 1, 1), (0, 0, 0))
+    assert modes == ("oext", "frozen", "frozen")
+
+
+def test_open_xz_window_chunk():
+    """Mixed torus: open x and z over a (2,2,2) mesh with periodic
+    extended y — open-edge freezing layered under later-dim extensions
+    (corner values ride the y-neighbors' own frozen x rows)."""
+    modes = _chunk_vs_per_step_open((2, 2, 2), (0, 1, 0))
+    assert modes == ("oext", "ext", "oext")
+
+
+def test_open_y_window_chunk():
+    """Periodic x/z rings around an open y split: 'oext' between two
+    periodic extensions."""
+    modes = _chunk_vs_per_step_open((2, 2, 2), (1, 0, 1))
+    assert modes == ("ext", "oext", "ext")
