@@ -13,19 +13,26 @@ SUPERSET of the reference's 510^3 (1.2% more cells; 510 is not
 slab-divisible for the fused kernel, and the comparison only gains from
 solving the slightly larger problem).  Both execution tiers are measured:
 
-  - `use_pallas=True` (the committed wall-clock): the per-step fused
-    kernel, 4.9 ms/step — the framework's recommended path, the analog of
-    the reference's native-kernel tier;
-  - the XLA broadcast-style path (9.1 ms/step), the abstraction-level
+  - `use_pallas=True` (the committed wall-clock): the K-step mega-kernel
+    in streamed-coefficient frozen-edge mode (round 5), 2.79 ms/step of
+    compute — the framework's recommended path, the analog of the
+    reference's native-kernel tier;
+  - the XLA broadcast-style path (~9.2 ms/step), the abstraction-level
     match for the reference's measured CuArray-broadcast version, emitted
     as `xla_ms_per_step` for the apples-to-apples reading.
 
 In-situ visualization fetches ONLY what each frame renders — the mid-z
 slice (~1 MB) — rather than the full 512 MB volume: this environment's
 tunneled device->host link moves ~25 MB/s (measured; a full-volume gather
-costs 20 s), where the reference's nodes had PCIe.  One full-volume
-`gather_interior` runs at the end (final state export) and is included in
-the wall-clock.
+costs 20 s), where the reference's nodes had PCIe.  The fetch + PNG
+rendering run on a BACKGROUND worker thread (round 5): frames are
+captured on device at sim time and handed off, so the host-side pipeline
+(matplotlib ~2 s/frame — ~3 ms/step of serial stall at the 1,000-step
+cadence, which round 4's runs paid in full) overlaps the next 1,000-step
+dispatch instead of serializing with it — in-situ vis must not stall the
+simulation.  One full-volume `gather_interior` runs at the end (final
+state export) and is included in the wall-clock, as is the final drain
+of the render queue.
 
 Usage: `python benchmarks/headline510.py [--steps N] [--outdir DIR]`.
 The committed artifact is a full 100k-step run.
@@ -34,7 +41,9 @@ The committed artifact is a full 100k-step run.
 from __future__ import annotations
 
 import pathlib
+import queue
 import sys
+import threading
 import time
 
 import numpy as np
@@ -88,36 +97,54 @@ def main():
     if outdir:
         outdir.mkdir(parents=True, exist_ok=True)
 
-    pending = []   # (step, device-resident mid-z slice)
+    # Background render worker: receives batches of (step, device-resident
+    # mid-z slice), fetches them (one batched ~10 MB transfer — the
+    # tunneled link is latency-bound at ~1.8 s per fetch regardless of
+    # size) and renders PNGs, all off the simulation thread.
+    # maxsize bounds the outstanding dispatch depth (~30 x 1,000-step
+    # programs): natural backpressure instead of a per-dispatch sync.
+    frames_q: "queue.Queue" = queue.Queue(maxsize=3)
+    render_errors = []
 
-    def flush_frames():
-        # The tunneled link is latency-bound (~1.8 s per fetch regardless of
-        # size), so frames are captured on device at sim time and fetched in
-        # batches of 10 (one ~10 MB transfer instead of ten 1 MB ones).
-        if not pending:
-            return
+    def render_worker():
         import jax.numpy as jnp
 
-        ks = [k for k, _ in pending]
-        stack = np.asarray(jnp.stack([s for _, s in pending]))
-        pending.clear()
-        if plt is not None and outdir:
-            for k, sl in zip(ks, stack):
-                plt.imshow(sl.T, origin="lower", cmap="inferno")
-                plt.title(f"T @ step {k}")
-                plt.savefig(outdir / f"T_{k:06d}.png", dpi=60)
-                plt.clf()
+        while True:
+            batch = frames_q.get()
+            if batch is None:
+                return
+            try:
+                ks = [k for k, _ in batch]
+                stack = np.asarray(jnp.stack([s for _, s in batch]))
+                if plt is not None and outdir:
+                    for k, sl in zip(ks, stack):
+                        plt.imshow(sl.T, origin="lower", cmap="inferno")
+                        plt.title(f"T @ step {k}")
+                        plt.savefig(outdir / f"T_{k:06d}.png", dpi=60)
+                        plt.clf()
+            except Exception as e:  # surfaced after the run
+                render_errors.append(e)
+
+    worker = threading.Thread(target=render_worker, daemon=True)
+    worker.start()
 
     t0 = time.monotonic()
     done = 0
+    pending = []   # (step, device-resident mid-z slice)
     while done < steps:
         T = step(T, Cp)
         done += vis_every
-        jax.block_until_ready(T)
         pending.append((done, T[:, :, T.shape[2] // 2]))
         if len(pending) >= 10:
-            flush_frames()
-    flush_frames()
+            frames_q.put(pending)
+            pending = []
+    if pending:
+        frames_q.put(pending)
+    frames_q.put(None)
+    jax.block_until_ready(T)
+    worker.join()   # the render drain is part of the wall-clock
+    if render_errors:
+        note(f"render worker errors: {render_errors[:3]}")
     # Final state export: one full-volume gather (tunnel-bound here).
     G = igg.gather_interior(T)
     if G is not None and outdir:

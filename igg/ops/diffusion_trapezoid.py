@@ -100,9 +100,13 @@ def _dim_modes(grid, force_y_ext=None, force_z_ext=None):
             modes.append("ext" if (d == 0 or grid.dims[d] > 1) else "wrap")
         else:
             modes.append("oext" if grid.dims[d] > 1 else "frozen")
-    if force_y_ext is not None:
+    # The force flags benchmark the (N,M,K) program shapes on a 1-device
+    # self-torus; they only rewire PERIODIC dims (ext <-> wrap) — an open
+    # dim keeps its open mode so the compiled-path gates still reject it
+    # (forcing 'ext' onto an open boundary would silently wrap it).
+    if force_y_ext is not None and grid.periods[1]:
         modes[1] = "ext" if force_y_ext else "wrap"
-    if force_z_ext is not None:
+    if force_z_ext is not None and grid.periods[2]:
         modes[2] = "ext" if force_z_ext else "wrap"
     return tuple(modes)
 

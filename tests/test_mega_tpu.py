@@ -411,7 +411,8 @@ def test_mega_streamed_a_matches_resident():
 
 
 @pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
-@pytest.mark.parametrize("periods", [(0, 0, 0), (0, 1, 1), (1, 1, 0)])
+@pytest.mark.parametrize("periods", [(0, 0, 0), (0, 1, 1), (1, 1, 0),
+                                     (1, 0, 1), (0, 0, 1)])
 @pytest.mark.parametrize("streamed", [False, True])
 def test_mega_frozen_modes_match_per_step_kernel(periods, streamed):
     """Open-boundary (frozen-edge) mega modes vs K applications of the
