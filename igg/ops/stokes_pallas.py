@@ -17,17 +17,18 @@ tier (">10x faster" than the array-broadcast form,
 reference's native tier — not just the single-device configuration.
 
 Measured on v5e at 128^3 f32 (median-of-3, 100-iteration dispatches,
-self-wrap grid): **0.138 ms/iter** vs 0.278 for the XLA composition
-(2.0x; round-4 artifact refresh of the rewritten mesh-capable kernel);
+self-wrap grid): **0.143 ms/iter** vs 0.224 for the XLA composition
+(1.57x; round-5 artifact refresh — the round-5 ext-plane writer gate
+also sped the composition itself up from 0.278);
 matches the XLA path to ~1e-7 relative on the chip (identical
 `iteration_core` arithmetic).  The DMA floor of this structure measured
 with a no-op core is 0.108 ms (~790 GB/s on ~85 MB/iter of traffic,
 including the 2x lane padding of Vz's (S,S,S+1) shape), so the remaining
 gap to ideal is non-overlapped VPU time.  `docs/stokes_roofline.md`
 carries the full traffic accounting: the structure is jointly DMA- and
-VPU-bound and its ceiling is ~2.3-2.6x — no per-iteration kernel of
-this solver reaches 3x at f32 128^3; only temporal blocking or bf16
-break the bound.
+VPU-bound and its ceiling is ~2.1x over the round-5 composition — no
+per-iteration kernel of this solver reaches 3x at f32 128^3; only
+temporal blocking or bf16 break the bound.
 
 Structure (the radius-2 staggered four-field instance of the
 `diffusion_pallas` recipe):
