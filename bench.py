@@ -109,6 +109,31 @@ def main():
           f"{pallas_sec * 1e3 if pallas_sec is not None else float('nan'):.3f}ms "
           f"~{gbps_ideal:.1f} GB/s ideal-fusion equiv", file=sys.stderr)
     igg.finalize_global_grid()
+
+    if platform == "tpu" and n == 256 and len(jax.devices()) == 1:
+        # The reference's published headline configuration, measured fresh
+        # each round: 512^3 OPEN boundaries on ONE chip (round 5:
+        # streamed-coefficient frozen-edge mega kernel; nx is a LOCAL
+        # size, so a multi-chip run would silently measure an exchanged
+        # 512^3-per-chip grid instead — hence the 1-device guard).
+        # Compute-only ms/step; the committed end-to-end wall-clock incl.
+        # in-situ vis is benchmarks/results/headline512.jsonl.  A failure
+        # here must not discard the primary 256^3 result above.
+        try:
+            igg.init_global_grid(512, 512, 512, quiet=True)
+            try:
+                sec512 = sorted(
+                    d3.run(nt, params, dtype=np.float32, n_inner=n_inner,
+                           use_pallas=True)[1] for _ in range(3))[1]
+                result["ms_per_step_512cubed_open"] = round(sec512 * 1e3, 4)
+                print(f"[bench] 512^3 open (headline config): "
+                      f"{sec512 * 1e3:.3f} ms/step", file=sys.stderr)
+            finally:
+                igg.finalize_global_grid()
+        except Exception as e:
+            result["ms_per_step_512cubed_open_error"] = (
+                f"{type(e).__name__}: {e}"[:200])
+
     print(json.dumps(result))
 
 

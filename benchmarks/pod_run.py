@@ -96,7 +96,7 @@ def main():
                          quiet=True)
     grid = igg.get_global_grid()
     for nfields in (1, 4):
-        sec, gbps, ndims = bench(n, nfields, np.float32, nt=nt,
+        sec, gbps, ndims = bench((n, n, n), nfields, np.float32, nt=nt,
                                  n_inner=n_inner)
         emit({
             "metric": "halo_exchange_bandwidth_per_chip",

@@ -45,6 +45,17 @@ links.
     the whole block with received planes selected in (`jnp.where` on
     `broadcasted_iota`), in dimension order.
 
+**Pair-emulated dtypes (round 5)** — f64 (the reference's Julia default),
+int64, complex: the XLA plans are chosen by op-mix ('select' one-pass for
+lane-active halo sets, all-DUS 'dus64' otherwise; `_assembly_plan`), the
+received planes are fenced with `optimization_barrier` before assembly
+(`_materialize_planes` — without the fence, copy-insertion charges
+full-block defensive copies), and non-lane fields take the sequential
+per-dim exchange+assemble form (`exchange_assemble_sequential` — the
+reference's literal control flow, corner propagation for free).  Measured
+at 256³/field: 519 µs xyz (2.49× the f32 writer for 2× the bytes), 53 µs
+xy (2.1× the f32 slab writers).
+
 The reference meets the same wall on GPUs — its maximally-strided dim-1
 plane gets a dedicated custom kernel (`/root/reference/src/update_halo.jl:
 439-462`); on TPU the tiled layout moves that worst case to the lane (minor)
