@@ -65,6 +65,9 @@ TOPOLOGIES = [
     ("v5e:2x4", (2, 2, 2), 0.94e9, 45e9, "v5e-8 (virtual, AOT)"),
     ("v5p:4x4x4", (4, 4, 4), 1.75e9, 90e9,
      "v5p-64 (virtual, AOT — the BASELINE weak-scaling target topology)"),
+    ("v5p:8x8x4", (8, 8, 4), 1.75e9, 90e9,
+     "v5p-256 (virtual, AOT — the BASELINE Stokes-overlap target "
+     "topology)"),
 ]
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "u32": 4,
