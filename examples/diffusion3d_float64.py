@@ -3,9 +3,12 @@
 The reference's examples allocate `Float64` arrays unless told otherwise
 (Julia's default; `/root/reference/docs/examples/diffusion3D_multigpu_
 CuArrays_novis.jl:26-28` writes `CUDA.zeros(Float64, ...)`), so a user
-porting a solver verbatim lands on this path.  It works end-to-end —
-same verbs, same physics, same decomposition invariance — with two
-TPU-specific facts worth knowing (measured; `docs/migration.md` §Float64):
+porting a solver verbatim lands on this path.  The port story is
+one line: the SAME example solver (`examples/diffusion3d_novis.py`),
+called with `dtype=float64` under `jax_enable_x64` — same verbs, same
+physics over local blocks, same decomposition invariance.  Two
+TPU-specific facts worth knowing (measured; `docs/migration.md`
+§Float64):
 
   - XLA:TPU emulates f64 as float-float (hi/lo f32) pairs: ~49 bits of
     effective mantissa and f32 dynamic range.  All on-device movement
@@ -29,61 +32,12 @@ import sys
 import jax
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 jax.config.update("jax_enable_x64", True)   # before any array is created
 
-import igg  # noqa: E402
-
-
-def diffusion3d_f64(nx=64, ny=64, nz=64, nt=100):
-    lam = 1.0
-    cp_min = 1.0
-    lx, ly, lz = 10.0, 10.0, 10.0
-
-    me, dims, nprocs, coords, mesh = igg.init_global_grid(nx, ny, nz)
-    dx = lx / (igg.nx_g() - 1)
-    dy = ly / (igg.ny_g() - 1)
-    dz = lz / (igg.nz_g() - 1)
-
-    import jax.numpy as jnp
-    T = igg.zeros((nx, ny, nz), dtype=np.float64)
-    X, Y, Z = igg.coord_fields(dx, dy, dz, T)
-    Cp = cp_min + 5 * jnp.exp(-(X - lx / 1.5) ** 2 - (Y - ly / 2) ** 2
-                              - (Z - lz / 1.5) ** 2) + 0 * T
-    T = 100 * jnp.exp(-((X - lx / 2) / 2) ** 2 - ((Y - ly / 2) / 2) ** 2
-                      - ((Z - lz / 3.0) / 2) ** 2) + 0 * T
-    assert T.dtype == np.float64
-
-    dt = min(dx * dx, dy * dy, dz * dz) * cp_min / lam / 8.1
-
-    @igg.sharded(donate_argnums=(0,))
-    def step(T, Cp):
-        qx = -lam * (T[1:, 1:-1, 1:-1] - T[:-1, 1:-1, 1:-1]) / dx
-        qy = -lam * (T[1:-1, 1:, 1:-1] - T[1:-1, :-1, 1:-1]) / dy
-        qz = -lam * (T[1:-1, 1:-1, 1:] - T[1:-1, 1:-1, :-1]) / dz
-        dTdt = (1.0 / Cp[1:-1, 1:-1, 1:-1]) * (
-            -(qx[1:, :, :] - qx[:-1, :, :]) / dx
-            - (qy[:, 1:, :] - qy[:, :-1, :]) / dy
-            - (qz[:, :, 1:] - qz[:, :, :-1]) / dz)
-        T = T.at[1:-1, 1:-1, 1:-1].add(dt * dTdt)
-        return igg.update_halo_local(T)
-
-    igg.tic()
-    for _ in range(nt):
-        T = step(T, Cp)
-    elapsed = igg.toc()
-
-    # Conservation sanity on the gathered interior (root only).
-    G = igg.gather_interior(T)
-    if me == 0:
-        G = np.asarray(G)
-        print(f"{nt} f64 steps on {nprocs} device(s), dims {dims}: "
-              f"{elapsed / nt * 1e3:.3f} ms/step; "
-              f"peak T = {G.max():.6f}, total heat = {G.sum():.6f}")
-
-    igg.finalize_global_grid()
-
+from diffusion3d_novis import diffusion3d  # noqa: E402
 
 if __name__ == "__main__":
-    diffusion3d_f64()
+    diffusion3d(nt=100, dtype=np.float64)

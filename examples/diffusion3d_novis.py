@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 import igg
 
 
-def diffusion3d(nx=64, ny=64, nz=64, nt=200):
+def diffusion3d(nx=64, ny=64, nz=64, nt=200, dtype=np.float32):
     # Physics
     lam = 1.0                 # thermal conductivity
     cp_min = 1.0              # minimal heat capacity
@@ -35,8 +35,8 @@ def diffusion3d(nx=64, ny=64, nz=64, nt=200):
 
     # Array initializations (globally-consistent via coordinate fields)
     import jax.numpy as jnp
-    T = igg.zeros((nx, ny, nz), dtype=np.float32)
-    X, Y, Z = igg.coord_fields(dx, dy, dz, T)
+    T = igg.zeros((nx, ny, nz), dtype=dtype)
+    X, Y, Z = (a.astype(dtype) for a in igg.coord_fields(dx, dy, dz, T))
     Cp = cp_min + 5 * jnp.exp(-(X - lx / 1.5) ** 2 - (Y - ly / 2) ** 2
                               - (Z - lz / 1.5) ** 2) + 0 * T
     T = 100 * jnp.exp(-((X - lx / 2) / 2) ** 2 - ((Y - ly / 2) / 2) ** 2
