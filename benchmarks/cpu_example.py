@@ -1,0 +1,68 @@
+"""The reference's CPU-example baseline row, measured on the CPU backend.
+
+`/root/reference/README.md:163`: 3-D heat diffusion at **254^3 global,
+100k steps** took **34 min wall-clock on 8 Intel Xeon E5-2690 v3
+processes** (one rank per socket-half, no threading) — i.e. 20.4 ms/step
+across 8 cores, ~163 ms/step-core.
+
+igg is TPU-first, but the same programs run on the XLA:CPU backend (the
+test suite's virtual-mesh backend).  This script measures the diffusion
+step at 254^3 global on however many host cores exist (THIS driver host
+has one) and emits ms/step plus the per-core-normalized comparison, so
+the baseline table's CPU row has a counterpart number instead of a
+shrug.  Not a headline — an honesty row.
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/cpu_example.py [n_global]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from common import emit, median_of, note
+
+
+def main():
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        note("cpu_example: not on the CPU backend; set JAX_PLATFORMS=cpu")
+        return
+
+    import igg
+    from igg.models import diffusion3d as d3
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 254
+    cores = os.cpu_count() or 1
+    igg.init_global_grid(n, n, n, dimx=1, dimy=1, dimz=1, quiet=True)
+    note(f"cpu_example: {n}^3 global, 1 process, {cores} host core(s)")
+
+    sec = median_of(lambda: d3.run(6, d3.Params(), dtype=np.float32,
+                                   n_inner=5, use_pallas=False)[1])
+    ms = sec * 1e3
+    ref_ms_per_step = 34 * 60 * 1e3 / 100_000        # 20.4 ms, 8 cores
+    ref_ms_per_step_core = ref_ms_per_step * 8       # ~163 ms/step-core
+    row = {
+        "metric": f"cpu_diffusion_{n}cubed_ms_per_step",
+        "value": round(ms, 2),
+        "unit": "ms",
+        "config": {"global": n, "devices": 1, "host_cores": cores,
+                   "platform": "cpu", "dtype": "float32"},
+    }
+    if n == 254:  # the published configuration; other sizes are smoke
+        row.update({
+            "reference_ms_per_step": round(ref_ms_per_step, 2),
+            "reference_hw": "8x Intel Xeon E5-2690 v3 processes "
+                            "(34 min / 100k steps at 254^3)",
+            "per_core_ratio_vs_reference": round(
+                (ms / cores) / ref_ms_per_step_core, 3),
+        })
+    emit(row)
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,9 @@ def main():
       tag="weak_scaling_mesh8")
     # The pod runbook (BASELINE configs 2/4/5 in one script), dry-run on the
     # virtual mesh so the real-slice launch path stays exercised.
+    # The reference's CPU-example baseline row (254^3 on the CPU
+    # backend; 64^3 in quick mode).
+    r("cpu_example.py", [] if not quick else [64], tag="cpu_example")
     r("pod_run.py", ["--local", 16, "--nt", 2, "--n-inner", 3], virtual=8,
       tag="pod_run_mesh8")
 
