@@ -467,3 +467,39 @@ def test_mega_frozen_modes_match_per_step_kernel(periods, streamed):
             sli[d] = edge
             assert np.array_equal(outn[tuple(sli)], Tn[tuple(sli)]), d
     igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_f64_rank4_halo_oracle_on_chip():
+    """Rank-4 component-stacked Float64 fields on real hardware: the halo
+    dims (0,1,2) miss the lane (component) axis, so this exercises the
+    pair-emulated 'dus64' sequential path end-to-end with trailing
+    unsharded dims (the reference's rank-generic GGArray in its default
+    dtype)."""
+    import jax
+    import jax.numpy as jnp
+
+    n, C = 32, 2
+    with jax.enable_x64(True):
+        igg.init_global_grid(n, n, n, dimx=1, dimy=1, dimz=1,
+                             periodx=1, periody=1, periodz=1, quiet=True)
+        i, j, k, c = np.meshgrid(np.arange(n), np.arange(n), np.arange(n),
+                                 np.arange(C), indexing="ij")
+        host = (((i * n + j) * n + k) * C + c).astype(np.float64)
+
+        out = np.asarray(igg.update_halo(jnp.asarray(host)))
+
+        exp = host.copy()
+        for d in range(3):
+            sl_first = [slice(None)] * 4
+            sl_last = [slice(None)] * 4
+            src_first = [slice(None)] * 4
+            src_last = [slice(None)] * 4
+            sl_first[d] = 0
+            src_first[d] = n - 2
+            sl_last[d] = n - 1
+            src_last[d] = 1
+            exp[tuple(sl_first)] = exp[tuple(src_first)]
+            exp[tuple(sl_last)] = exp[tuple(src_last)]
+        assert np.array_equal(out, exp), np.argwhere(out != exp)[:5]
+        igg.finalize_global_grid()
