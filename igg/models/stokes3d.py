@@ -169,9 +169,11 @@ def local_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
 
 _PALLAS_REQ = (
     "the fused Stokes iteration requires TPU devices (or "
-    "pallas_interpret=True), an overlap-3 grid, and f32 fields with local "
-    "shape divisible into x-slabs (x % 8 == 0, x >= 16, y >= 8, z >= 8); "
-    "use the XLA path otherwise.")
+    "pallas_interpret=True), an overlap-3 grid, f32 fields with local "
+    "shape divisible into x-slabs (x % 8 == 0, x >= 16, y >= 8, z >= 8), "
+    "and in compiled mode a y*z area small enough that some slab height's "
+    "windows fit the VMEM budget (igg.ops.stokes_pallas._vmem_need); use "
+    "the XLA path otherwise.")
 
 
 def _pallas_applicable(use_pallas, P, interpret: bool = False) -> bool:
@@ -179,9 +181,13 @@ def _pallas_applicable(use_pallas, P, interpret: bool = False) -> bool:
 
     from ._dispatch import pallas_applicable
 
-    return pallas_applicable(use_pallas, P,
-                             supported_fn=stokes_pallas_supported,
-                             requirement=_PALLAS_REQ, interpret=interpret)
+    # interpret mode has no Mosaic and no VMEM budget: thread the flag into
+    # the supported gate so large-y*z grids stay interpret-runnable.
+    return pallas_applicable(
+        use_pallas, P,
+        supported_fn=lambda g, F: stokes_pallas_supported(
+            g, F, interpret=interpret),
+        requirement=_PALLAS_REQ, interpret=interpret)
 
 
 def _pseudo_steps(params: Params):

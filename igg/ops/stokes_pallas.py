@@ -83,25 +83,65 @@ from functools import partial
 
 from .diffusion_pallas import _wrap_dims, _wrap_set
 
-# Deliberately TIGHT: the scoped-vmem budget steers Mosaic's scheduling, and
-# a small budget produces far better DMA/compute interleaving for this
-# kernel.  Swept on v5e at 128^3 (median-of-3, ms/iter): 20MB 0.138,
-# 26MB 0.137, 32MB 0.136, 44MB 0.139, 56MB 0.157, 64MB 0.175, 100MB 0.224,
-# 128MB 0.40.  The kernel's own working set fits comfortably below 20MB.
-_VMEM_LIMIT = 32 * 1024 * 1024
+# Deliberately TIGHT when the working set allows: the scoped-vmem budget
+# steers Mosaic's scheduling, and a small budget produces far better
+# DMA/compute interleaving for this kernel.  Swept on v5e at 128^3
+# (median-of-3, ms/iter): 20MB 0.138, 26MB 0.137, 32MB 0.136, 44MB 0.139,
+# 56MB 0.157, 64MB 0.175, 100MB 0.224, 128MB 0.40.  At 128^3 the working
+# set sits below 20MB and the floor budget applies; larger y*z areas
+# (256^3-class) NEED more than the floor — the per-call limit grows with
+# `_vmem_need` up to the hard cap (round 5: 256^3 OOM'd at Mosaic compile
+# under the fixed 32MB budget; with the grown 82MB budget it runs
+# bx=8 at 2.19 ms/iter vs 7.42 XLA — 3.4x — the shipped-and-measured
+# configuration).
+_VMEM_FLOOR = 32 * 1024 * 1024
+_VMEM_CAP = 110 * 1024 * 1024
 
 
-def stokes_pallas_supported(grid, P) -> bool:
+def _vmem_limit(bx: int, S1: int, S2: int) -> int:
+    return max(_VMEM_FLOOR, min(_VMEM_CAP, _vmem_need(bx, S1, S2)))
+
+
+def _vmem_need(bx: int, S1: int, S2: int, itemsize: int = 4) -> int:
+    """VMEM bytes the fused iteration's windows demand at slab height
+    `bx`: per input field (P,Vx,Vy,Vz) a bx-row center window plus 2-3
+    single-row side windows, a bx-row Rho window, four bx-row outputs —
+    all double-buffered — plus ~10 single-buffered plane windows.  The
+    row count is `9*bx + 10` to first order; the 2.0x margin absorbs
+    Mosaic's own scratch (calibrated against the observed 256^3 compile
+    footprint: 69.3 MB demanded where the first-order model says 41)."""
+    rows = 9 * bx + 10
+    return int(2 * rows * S1 * S2 * itemsize * 2.0)
+
+
+def _fit_bx(bx: int, S0: int, S1: int, S2: int,
+            check_vmem: bool = True) -> int:
+    """Largest slab height <= bx that divides S0 and (in compiled mode)
+    fits the VMEM budget; 0 when none does.  `check_vmem=False` is the
+    interpret-mode form — no Mosaic, no budget."""
+    while bx >= 4:
+        if S0 % bx == 0 and (not check_vmem
+                             or _vmem_need(bx, S1, S2) <= _VMEM_CAP):
+            return bx
+        bx //= 2
+    return 0
+
+
+def stokes_pallas_supported(grid, P, interpret: bool = False) -> bool:
     """Whether the fused iteration applies: overlap-3 grid (any device
     count and any periodicity — the exchange engine handles open boundaries
     and multi-device meshes), unstaggered-pressure local block large enough
-    to slab."""
+    to slab, and some slab height whose windows fit VMEM (large y*z areas
+    push the per-slab windows past the budget — caught by the round-5
+    256^3 probe, where the unguarded kernel OOM'd at Mosaic compile)."""
     if grid.overlaps != (3, 3, 3) or P.ndim != 3:
         return False
     s = tuple(grid.local_shape_any(P))
     if s != tuple(grid.nxyz):
         return False
-    return s[0] % 8 == 0 and s[0] >= 16 and s[1] >= 8 and s[2] >= 8
+    if not (s[0] % 8 == 0 and s[0] >= 16 and s[1] >= 8 and s[2] >= 8):
+        return False
+    return _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) >= 4
 
 
 def _win_x(P, Vx, Vy, Vz, Rho, scal, lo, hi):
@@ -315,10 +355,13 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
 
     grid = shared.global_grid()
     S0, S1, S2 = P.shape
-    while S0 % bx != 0:
-        bx //= 2
+    # Shrink the slab height until it divides S0 AND (compiled mode) its
+    # windows fit the VMEM budget, which scales with S1*S2 (`_vmem_need`).
+    bx = _fit_bx(bx, S0, S1, S2, check_vmem=not interpret)
     if bx < 4:
-        raise ValueError(f"x size {S0} not divisible into slabs of >= 4 rows")
+        raise ValueError(
+            f"x size {S0} not divisible into slabs of >= 4 rows whose "
+            f"windows fit VMEM at y*z area {S1}x{S2}")
     nb = S0 // bx
     scal = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
     shapes = [P.shape, Vx.shape, Vy.shape, Vz.shape, Rho.shape]
@@ -393,7 +436,7 @@ def fused_stokes_iteration(P, Vx, Vy, Vz, Rho, *, dx, dy, dz, mu, dtP, dtV,
     if not interpret:
         from jax.experimental.pallas import tpu as pltpu
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT,
+            vmem_limit_bytes=_vmem_limit(bx, S1, S2),
             dimension_semantics=("parallel",))
 
     Pn, Vxn, Vyn, Vzn = pl.pallas_call(
