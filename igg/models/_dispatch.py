@@ -21,6 +21,8 @@ def pallas_applicable(use_pallas, field, *, supported_fn, requirement,
     mode), f32 fields, and the family's `supported_fn` gate.  Raises
     `GridError(requirement)` when `use_pallas is True` but the kernel is
     inapplicable."""
+    import inspect
+
     import jax.numpy as jnp
 
     if use_pallas is False:
@@ -28,8 +30,13 @@ def pallas_applicable(use_pallas, field, *, supported_fn, requirement,
     grid = igg.get_global_grid()
     platform_ok = (interpret
                    or next(iter(grid.mesh.devices.flat)).platform == "tpu")
+    # Gates that distinguish interpret mode (no Mosaic, no VMEM budget —
+    # stokes/hm3d) receive the flag; older two-arg gates are unchanged.
+    kw = ({"interpret": interpret}
+          if "interpret" in inspect.signature(supported_fn).parameters
+          else {})
     ok = (platform_ok and field.dtype == jnp.float32
-          and supported_fn(grid, field))
+          and supported_fn(grid, field, **kw))
     if use_pallas is True and not ok:
         raise igg.GridError(requirement)
     return ok

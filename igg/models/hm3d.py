@@ -127,7 +127,9 @@ _PALLAS_REQ = (
     "the fused HM3D step requires TPU devices (or pallas_interpret=True), "
     "an overlap-2 grid, and f32 unstaggered fields with local shape "
     "divisible into x-slabs (x % 4 == 0, y >= 8, z >= 8; z >= 128 when z "
-    "is exchanged); use the XLA path otherwise.")
+    "is exchanged), and in compiled mode a y*z area small enough that some "
+    "slab height's windows fit the VMEM budget "
+    "(igg.ops.hm3d_pallas._vmem_need); use the XLA path otherwise.")
 
 
 def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
@@ -135,6 +137,8 @@ def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
 
     from ._dispatch import pallas_applicable
 
+    # `pallas_applicable` threads `interpret` into the gate (no Mosaic,
+    # no VMEM budget there), so large-y*z grids stay interpret-runnable.
     return pallas_applicable(use_pallas, Pe,
                              supported_fn=hm3d_pallas_supported,
                              requirement=_PALLAS_REQ, interpret=interpret)

@@ -181,13 +181,11 @@ def _pallas_applicable(use_pallas, P, interpret: bool = False) -> bool:
 
     from ._dispatch import pallas_applicable
 
-    # interpret mode has no Mosaic and no VMEM budget: thread the flag into
-    # the supported gate so large-y*z grids stay interpret-runnable.
-    return pallas_applicable(
-        use_pallas, P,
-        supported_fn=lambda g, F: stokes_pallas_supported(
-            g, F, interpret=interpret),
-        requirement=_PALLAS_REQ, interpret=interpret)
+    # `pallas_applicable` threads `interpret` into the gate (no Mosaic,
+    # no VMEM budget there), so large-y*z grids stay interpret-runnable.
+    return pallas_applicable(use_pallas, P,
+                             supported_fn=stokes_pallas_supported,
+                             requirement=_PALLAS_REQ, interpret=interpret)
 
 
 def _pseudo_steps(params: Params):

@@ -1,0 +1,35 @@
+"""Shared per-call scoped-VMEM budgeting for the fused model kernels.
+
+The stokes/hm3d fused kernels keep a deliberately TIGHT vmem budget when
+their working set allows (small budgets steer Mosaic to the best
+DMA/compute interleave — see the sweep in `stokes_pallas.py`), but large
+y*z window areas NEED more than the floor: round 5 found both kernels
+OOM-ing at Mosaic compile on 256^3/512^3-class blocks under their fixed
+32 MB budgets, with `use_pallas="auto"` users crashing instead of falling
+back.  Each kernel supplies its own first-order window-footprint model
+(`need_fn(bx, S1, S2)`); this module owns the shared floor/cap and the
+slab-height fitting so the two cannot drift."""
+
+from __future__ import annotations
+
+VMEM_FLOOR = 32 * 1024 * 1024
+VMEM_CAP = 110 * 1024 * 1024
+
+
+def vmem_limit(need: int) -> int:
+    """The per-call scoped-vmem budget for a modeled footprint."""
+    return max(VMEM_FLOOR, min(VMEM_CAP, need))
+
+
+def fit_bx(need_fn, bx: int, S0: int, S1: int, S2: int, *,
+           min_bx: int, check_vmem: bool = True) -> int:
+    """Largest slab height <= bx (halving, >= `min_bx`) that divides S0
+    and — in compiled mode — whose modeled footprint fits the cap; 0 when
+    none does.  `check_vmem=False` is the interpret-mode form: no Mosaic,
+    no budget."""
+    while bx >= min_bx:
+        if S0 % bx == 0 and (not check_vmem
+                             or need_fn(bx, S1, S2) <= VMEM_CAP):
+            return bx
+        bx //= 2
+    return 0

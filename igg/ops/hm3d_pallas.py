@@ -69,12 +69,35 @@ from __future__ import annotations
 
 from .diffusion_pallas import _check_applicable, _wrap_dims, _wrap_set
 
-# See stokes_pallas._VMEM_LIMIT: a tight scoped-vmem budget steers Mosaic
+# See stokes_pallas: a tight scoped-vmem budget steers Mosaic
 # toward better DMA/compute interleaving for slab kernels of this shape.
-_VMEM_LIMIT = 32 * 1024 * 1024
+from ._vmem import fit_bx, vmem_limit
 
 
-def hm3d_pallas_supported(grid, Pe) -> bool:
+def _vmem_need(bx: int, S1: int, S2: int, itemsize: int = 4) -> int:
+    """First-order window footprint of the fused step at slab height
+    `bx`: two fields x (bx-row center + 2 single-row sides) + two bx-row
+    outputs + compact slab emissions, double-buffered; the 2.0x margin
+    absorbs Mosaic scratch (same calibration as
+    `stokes_pallas._vmem_need` — the fixed 32 MB budget OOM'd the
+    512^3 per-step compile, caught round 5)."""
+    rows = 4 * bx + 8
+    return int(2 * rows * S1 * S2 * itemsize * 2.0)
+
+
+def _vmem_limit(bx: int, S1: int, S2: int) -> int:
+    return vmem_limit(_vmem_need(bx, S1, S2))
+
+
+def _fit_bx(bx: int, S0: int, S1: int, S2: int,
+            check_vmem: bool = True) -> int:
+    # min_bx=2: `_check_applicable` accepts bx=2 slabs and the per-step
+    # kernel ran them before the round-5 VMEM gating.
+    return fit_bx(_vmem_need, bx, S0, S1, S2, min_bx=2,
+                  check_vmem=check_vmem)
+
+
+def hm3d_pallas_supported(grid, Pe, interpret: bool = False) -> bool:
     """Whether the fused step applies: 3-D unstaggered overlap-2 grid (any
     device count and any periodicity — the exchange engine handles open
     boundaries and multi-device meshes), local blocks large enough to slab.
@@ -88,7 +111,11 @@ def hm3d_pallas_supported(grid, Pe) -> bool:
     if not (s[0] % 4 == 0 and s[0] >= 8 and s[1] >= 8 and s[2] >= 8):
         return False
     _, wz = _wrap_dims(grid)
-    return wz or s[2] >= 128
+    if not (wz or s[2] >= 128):
+        return False
+    # Some slab height must fit the VMEM cap in compiled mode (512^3-class
+    # y*z areas overflow the fixed budget — round 5).
+    return _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) >= 2
 
 
 def _updated(wPe, wphi, kw):
@@ -289,7 +316,7 @@ def _call_kernel(Pe, phi, recvs, kw_core, bx, interpret, wrap_yz,
     if not interpret:
         from jax.experimental.pallas import tpu as pltpu
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT)
+            vmem_limit_bytes=_vmem_limit(bx, S1, S2))
 
     operands, in_specs = [], []
     for F in (Pe, phi):
@@ -359,6 +386,13 @@ def fused_hm3d_step(Pe, phi, *, dx, dy, dz, dt, phi0, npow, eta,
 
     grid = shared.global_grid()
     bx, dims_active = _check_applicable(grid, Pe.shape, bx)
+    bx = _fit_bx(bx, *Pe.shape, check_vmem=not interpret)
+    if bx < 2:
+        raise ValueError(
+            f"no slab height divides x size {Pe.shape[0]}"
+            + ("" if interpret else
+               f" with windows fitting the VMEM budget at y*z area "
+               f"{Pe.shape[1]}x{Pe.shape[2]}"))
     kw = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0, npow=npow, eta=eta)
     wrap_yz = _wrap_dims(grid)
     slabs = _boundary_slabs(Pe, phi, wrap_yz)
@@ -394,6 +428,15 @@ def fused_hm3d_steps(Pe, phi, *, n_inner, dx, dy, dz, dt, phi0, npow, eta,
             return fused_hm3d_megasteps(Pe, phi, n_inner=n_inner, bx=bx,
                                         **kw)
 
+    # Per-step loop path: the slab height must also fit the VMEM budget
+    # (the mega branch above sizes its own buffers).
+    bx = _fit_bx(bx, *Pe.shape, check_vmem=not interpret)
+    if bx < 2:
+        raise ValueError(
+            f"no slab height divides x size {Pe.shape[0]}"
+            + ("" if interpret else
+               f" with windows fitting the VMEM budget at y*z area "
+               f"{Pe.shape[1]}x{Pe.shape[2]}"))
     init_slabs = _boundary_slabs(Pe, phi, wrap_yz)
     keep = [j for j, sl in enumerate(init_slabs) if sl is not None]
 

@@ -94,12 +94,11 @@ from .diffusion_pallas import _wrap_dims, _wrap_set
 # under the fixed 32MB budget; with the grown 82MB budget it runs
 # bx=8 at 2.19 ms/iter vs 7.42 XLA — 3.4x — the shipped-and-measured
 # configuration).
-_VMEM_FLOOR = 32 * 1024 * 1024
-_VMEM_CAP = 110 * 1024 * 1024
+from ._vmem import fit_bx, vmem_limit
 
 
 def _vmem_limit(bx: int, S1: int, S2: int) -> int:
-    return max(_VMEM_FLOOR, min(_VMEM_CAP, _vmem_need(bx, S1, S2)))
+    return vmem_limit(_vmem_need(bx, S1, S2))
 
 
 def _vmem_need(bx: int, S1: int, S2: int, itemsize: int = 4) -> int:
@@ -116,15 +115,8 @@ def _vmem_need(bx: int, S1: int, S2: int, itemsize: int = 4) -> int:
 
 def _fit_bx(bx: int, S0: int, S1: int, S2: int,
             check_vmem: bool = True) -> int:
-    """Largest slab height <= bx that divides S0 and (in compiled mode)
-    fits the VMEM budget; 0 when none does.  `check_vmem=False` is the
-    interpret-mode form — no Mosaic, no budget."""
-    while bx >= 4:
-        if S0 % bx == 0 and (not check_vmem
-                             or _vmem_need(bx, S1, S2) <= _VMEM_CAP):
-            return bx
-        bx //= 2
-    return 0
+    return fit_bx(_vmem_need, bx, S0, S1, S2, min_bx=4,
+                  check_vmem=check_vmem)
 
 
 def stokes_pallas_supported(grid, P, interpret: bool = False) -> bool:
