@@ -118,10 +118,23 @@ def main():
         dtypes = (np.float32, np.float16)
     else:
         dtypes = (np.float32, jnp.bfloat16, np.float64)
-    for halo_dims, periods in (("xyz", (1, 1, 1)), ("xy", (1, 1, 0))):
+    # `xyz_open` (round 6): every dim non-periodic — the reference's
+    # DEFAULT boundary condition.  Exchanges happen only where a dim is
+    # split across devices (no-write global edges), so the set is skipped
+    # on a single chip (nothing moves there) and measures the per-step
+    # open-boundary exchange cost — exactly what the open K-step chunk
+    # tier amortizes by 1/K — on multi-device meshes.
+    for halo_dims, periods in (("xyz", (1, 1, 1)), ("xy", (1, 1, 0)),
+                               ("xyz_open", (0, 0, 0))):
         igg.init_global_grid(n, n, n, periodx=periods[0], periody=periods[1],
                              periodz=periods[2], quiet=True)
         grid = igg.get_global_grid()
+        from igg.halo import active_dims as _ad, moving_dims as _md
+        if not _md(_ad((n, n, n), grid), grid):
+            note(f"halo_dims={halo_dims}: no moving dims on this mesh "
+                 f"(dims={grid.dims}); skipping")
+            igg.finalize_global_grid()
+            continue
         note(f"platform={platform} devices={grid.nprocs} dims={grid.dims} "
              f"local={n}^3 halo_dims={halo_dims} n_inner={n_inner}")
         for nfields in (1, 2, 4):

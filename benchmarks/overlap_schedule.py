@@ -74,19 +74,36 @@ _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "u32": 4,
                 "s32": 4, "u8": 1, "pred": 1}
 
 
-def _init_grid(n, topo, **grid_kwargs):
+def _init_grid(n, topo, periods=(1, 1, 1), mesh_dims=None, **grid_kwargs):
+    """`mesh_dims` overrides the topology's labeled dims (the trapezoid
+    programs use the recommended `(N,1,1)` pod decomposition — the chunk
+    tier's VMEM gate rejects 256^3 locals with BOTH y and z extended, so
+    on the labeled 3-D meshes the dispatcher would silently fall back to
+    the per-step program and the row would mislabel what it measured);
+    rows carry the actual program mesh in `program_mesh_dims`."""
     import igg
 
     want_dims = getattr(topo, "igg_want_dims", None)
-    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+    dim_kw = {}
+    if mesh_dims is not None:
+        dim_kw = dict(dimx=mesh_dims[0], dimy=mesh_dims[1],
+                      dimz=mesh_dims[2])
+    igg.init_global_grid(n, n, n, periodx=periods[0], periody=periods[1],
+                         periodz=periods[2],
                          quiet=True, devices=list(topo.devices),
-                         **grid_kwargs)
+                         **dim_kw, **grid_kwargs)
     grid = igg.get_global_grid()
-    if want_dims is not None and tuple(grid.dims) != tuple(want_dims):
+    if (mesh_dims is None and want_dims is not None
+            and tuple(grid.dims) != tuple(want_dims)):
         raise AssertionError(
             f"mesh dims {tuple(grid.dims)} != labeled dims {want_dims}; "
             f"the artifact row would mislabel the program")
     return grid
+
+
+# Per-program extras merged into the emitted row by main(): the trapezoid
+# compile fns record their actual mesh and assert the chunk tier engaged.
+_PROGRAM_INFO: dict = {}
 
 
 def _lower(fn, global_shapes, grid, nfields_spec=None):
@@ -175,14 +192,27 @@ def compile_hm3d(n, topo):
     return txt
 
 
-def compile_trapezoid(n, topo, n_inner=17, bx=8):
-    """K-step trapezoid chunk program (Pallas kernels + K-deep slab
-    ppermutes) on the fully periodic torus."""
+def _compile_trapezoid_common(n, topo, periods, n_inner, bx):
+    """Shared trapezoid-program lowering on the recommended `(N,1,1)` pod
+    decomposition, ASSERTING the chunk tier engages (a silent per-step
+    fallback would mislabel the row — exactly what happened to the
+    round-5 rows, whose (2,2,2) mesh at 256^3 failed the VMEM gate)."""
+    import numpy as np
+
     import igg
     from igg.ops import fused_diffusion_steps
+    from igg.ops.diffusion_trapezoid import trapezoid_supported
 
-    grid = _init_grid(n, topo)
+    ndev = len(topo.devices)
+    grid = _init_grid(n, topo, periods=periods, mesh_dims=(ndev, 1, 1))
     dims = grid.dims
+    assert trapezoid_supported(grid, (n, n, n), bx, n_inner - 1,
+                               np.float32, allow_open=True), (
+        "chunk tier did not engage; the row would record the per-step "
+        "program instead")
+    _PROGRAM_INFO.clear()
+    _PROGRAM_INFO.update({"program_mesh_dims": list(dims),
+                          "chunk_tier_engaged": True})
     from igg.models import diffusion3d as d3
 
     params = d3.Params()
@@ -199,15 +229,37 @@ def compile_trapezoid(n, topo, n_inner=17, bx=8):
     return txt
 
 
+def compile_trapezoid(n, topo, n_inner=17, bx=8):
+    """K-step trapezoid chunk program (Pallas kernels + K-deep slab
+    ppermutes) on the fully periodic `(N,1,1)` ring."""
+    return _compile_trapezoid_common(n, topo, (1, 1, 1), n_inner, bx)
+
+
+def compile_trapezoid_open(n, topo, n_inner=17, bx=8):
+    """Round 6: the OPEN-boundary (reference-default) K-step trapezoid
+    chunk program on the `(N,1,1)` decomposition — "oext" x (non-wrapping
+    slab ppermutes + SMEM `axis_index` edge flags + VMEM freeze planes),
+    frozen y/z.  Compiling this through the real Mosaic lowering is the
+    chipless proof that the open chunk kernel builds for the target
+    topologies."""
+    return _compile_trapezoid_common(n, topo, (0, 0, 0), n_inner, bx)
+
+
 # (name, compile_fn, steps_per_program, measured_compute_s_per_step)
 # The last field substitutes a MEASURED per-step compute time where the
-# XLA cost model is blind (Mosaic custom-calls): the trapezoid kernel
-# measured 0.397 ms/step at 256^3 on the real v5e chip
-# (benchmarks/results/pallas_sweep.jsonl, trapezoid_torus_bx8); the v5p
-# figure scales it by the public HBM-bandwidth ratio (~2765/819 = 3.4x —
-# the kernel is bandwidth-bound at 507 GB/s of ideal traffic).  For
-# custom-call programs the overlap fraction used in the efficiency model
-# is the STRUCTURAL one: custom-calls issued with a permute in flight.
+# XLA cost model is blind (Mosaic custom-calls): the trapezoid ring
+# kernel measured 0.3036 ms/step at 256^3 on the real v5e chip
+# (benchmarks/results/pallas_sweep.jsonl, trapezoid_ring_bx8 — the
+# (N,1,1) program these rows now actually compile; the round-5 rows used
+# the torus figure but silently lowered the per-step fallback, see
+# `_compile_trapezoid_common`); the v5p figure scales it by the public
+# HBM-bandwidth ratio (~2765/819 = 3.4x — the kernel is bandwidth-bound).
+# The OPEN row reuses the periodic ring figure as a proxy until a
+# measured `trapezoid_open_bx8` row lands (the kernel does identical work
+# plus two boundary-plane freeze writes per open dim per step, a
+# negligible VMEM-local cost).  For custom-call programs the overlap
+# fraction used in the efficiency model is the STRUCTURAL one:
+# custom-calls issued with a permute in flight.
 PROGRAMS = [
     ("diffusion3d hide_communication step", compile_diffusion, 1, None),
     ("stokes3d hide_communication iteration (radius-2, 4 fields)",
@@ -215,7 +267,10 @@ PROGRAMS = [
     ("hm3d hide_communication coupled step (2 fields)", compile_hm3d, 1,
      None),
     ("diffusion3d trapezoid K-step chunks (Pallas + slab ppermutes)",
-     compile_trapezoid, 17, {"v5e": 3.97e-4, "v5p": 3.97e-4 / 3.4}),
+     compile_trapezoid, 17, {"v5e": 3.036e-4, "v5p": 3.036e-4 / 3.4}),
+    ("diffusion3d trapezoid K-step chunks, OPEN boundaries (frozen-edge "
+     "Mosaic kernel; compute time proxied from the periodic ring row)",
+     compile_trapezoid_open, 17, {"v5e": 3.036e-4, "v5p": 3.036e-4 / 3.4}),
 ]
 
 
@@ -342,6 +397,7 @@ def main():
             continue
         topo.igg_want_dims = want_dims
         for prog_name, compile_fn, steps, measured in PROGRAMS:
+            _PROGRAM_INFO.clear()
             try:
                 txt = compile_fn(n, topo)
             except Exception as e:
@@ -379,6 +435,7 @@ def main():
                 **{k: v for k, v in stats.items()
                    if k != "overlap_fraction"},
                 **pred,
+                **dict(_PROGRAM_INFO),
                 "smoke": False,
             })
 

@@ -112,6 +112,78 @@ def main():
                                              lambda _, T: step(T), T),
                      donate_argnums=0)
         measure("perstep_ring_bx16", fn, T, n_inner)
+
+    if platform == "tpu":
+        # OPEN boundaries — the reference's default (its examples are
+        # non-periodic) — on the compiled K-step chunk tier (round 6): on
+        # one chip every open dim runs the "frozen" edge-freeze mode
+        # (multi-device grids run "oext"; same kernel, flag-gated), vs the
+        # per-step kernel on the same open grid.
+        igg.finalize_global_grid()
+        igg.init_global_grid(n, n, n, quiet=True)   # all dims open
+        grid = igg.get_global_grid()
+
+        def fresh_open():
+            T, Cp = d3.init_fields(params, dtype=np.float32)
+            return igg.update_halo(T), Cp
+
+        for bx in (8, 16):
+            T, Cp = fresh_open()
+            A = float(dt * params.lam) / Cp
+            if not trapezoid_supported(grid, T.shape, bx, n_inner,
+                                       T.dtype, allow_open=True):
+                note(f"trapezoid open bx={bx}: unsupported at {n}^3")
+                continue
+            steps = (n_inner // bx) * bx
+            fn = jax.jit(
+                lambda T, bx=bx, A=A, s=steps:
+                fused_diffusion_trapezoid_steps(
+                    T, A, n_inner=s, bx=bx, grid=grid, **scal)[0],
+                donate_argnums=0)
+            measure(f"trapezoid_open_bx{bx}", fn, T, steps)
+
+        T, Cp = fresh_open()
+        step = lambda T: fused_diffusion_step(
+            T, Cp, dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam, bx=16)
+        fn = jax.jit(lambda T: lax.fori_loop(0, n_inner,
+                                             lambda _, T: step(T), T),
+                     donate_argnums=0)
+        measure("perstep_open_bx16", fn, T, n_inner)
+
+    # Every platform: the open-boundary chunk path's XLA window
+    # realization (interpret mode — same gates, same chunked structure) at
+    # a fixed small shape, so the CI bench smoke always carries one
+    # open-boundary chunk row (round 6) regardless of the host's
+    # accelerator and of `n`.
+    from igg.ops.diffusion_trapezoid import (
+        fused_diffusion_trapezoid_steps as _traps,
+        trapezoid_supported as _trap_ok)
+    from igg.timing import time_steps
+
+    igg.finalize_global_grid()
+    igg.init_global_grid(16, 16, 128, quiet=True)   # all dims open
+    grid = igg.get_global_grid()
+    dx, dy, dz = params.spacing()
+    scal16 = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                  rdz2=1.0 / (dz * dz))
+    bx = 8
+    assert _trap_ok(grid, (16, 16, 128), bx, bx, np.float32,
+                    allow_open=True)
+    T = igg.update_halo(igg.zeros((16, 16, 128), dtype=np.float32) + 1)
+    A = igg.zeros((16, 16, 128), dtype=np.float32) + 0.05
+    # igg.sharded, not plain jit: on a virtual multi-device host the open
+    # dims run "oext" and the slab exchange needs the mesh axes bound.
+    step_open = igg.sharded(
+        lambda T, A: _traps(T, A, n_inner=bx, bx=bx, grid=grid, **scal16,
+                            interpret=True)[0], donate_argnums=(0,))
+    _, sec = time_steps(lambda T, A: (step_open(T, A), A), (T, A),
+                        n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "trapezoid_open_interpret_bx8", "local": 16,
+        "value": round(sec / bx * 1e3, 4), "unit": "ms",
+        "platform": platform,
+    })
     igg.finalize_global_grid()
 
 

@@ -24,6 +24,10 @@ compilation (`sharded`, `update_halo_local`, `local_coords`), and
 `gather_interior`.
 """
 
+from ._compat import install as _compat_install
+
+_compat_install()
+
 from .shared import (
     AXIS_NAMES,
     NDIMS,

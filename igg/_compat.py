@@ -1,0 +1,47 @@
+"""Guarded compatibility grafts for older JAX releases.
+
+igg targets the toolchain's current JAX surface (`jax.shard_map` with
+`check_vma`, `ShapeDtypeStruct(..., vma=...)`); on a modern install every
+graft below is a no-op (`hasattr` guards), so the production environment
+never sees patched behavior.  On older releases (<= 0.4.x, where
+`shard_map` still lives in `jax.experimental` and varying-manual-axes
+checking is called `check_rep`) the grafts map the new names onto the old
+implementations so the CPU-mesh test suite and the examples still run —
+the repo's "stub or gate missing deps" policy applied to the JAX API
+itself.  `ShapeDtypeStruct(vma=...)` needs no graft: every igg call site
+already branches on whether the incoming aval carries a `vma`.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *args, **kwargs):
+            # API-faithful pass-through shim: every old-API argument
+            # (positional or keyword, incl. check_rep/auto) forwards
+            # unchanged, so other in-process libraries feature-detecting
+            # `jax.shard_map` see the experimental implementation's own
+            # contract.  Only the new-API `check_vma` flag is translated:
+            # check_rep (its old name) predates the vma machinery and is
+            # stricter about primitives it has no rules for (pallas_call),
+            # so it defaults off — new-JAX environments keep real
+            # check_vma and never reach this shim.
+            kwargs.pop("check_vma", None)
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = shard_map
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pallas not shipped: nothing to graft
+        return
+
+    if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams

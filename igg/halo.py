@@ -503,8 +503,10 @@ def _fence_recv(out, recv: Dict, dims_active, on_tpu: bool):
 
 def exchange_assemble_sequential(fields, dims_actives, grid, plans):
     """Sequential per-dimension exchange-and-assemble for XLA-plan fields:
-    for each dimension in ascending order, send planes are extracted as
-    LAZY slices of the current (partially updated) blocks, exchanged, and
+    for each dimension in ascending order, send planes are extracted from
+    the current (partially updated) blocks — as LAZY slices, except
+    minor-dim planes of 32-bit fields that must materialize for the wire,
+    which ride the `pack_planes` one-pass extractor — exchanged, and
     assembled straight back into the blocks with the field's plan form.
 
     This is the reference's literal control flow
@@ -524,6 +526,10 @@ def exchange_assemble_sequential(fields, dims_actives, grid, plans):
     The grouped pre-extracted form (:func:`exchange_all_dims_grouped`)
     remains the engine path for Pallas-writer fields, whose assembly is an
     opaque kernel that needs all planes materialized up front."""
+    import jax.numpy as jnp
+
+    from .ops.pack import pack_planes, pack_planes_supported
+
     nf = len(fields)
     vb = list(fields)
     on_tpu = _is_tpu(grid)
@@ -539,11 +545,27 @@ def exchange_assemble_sequential(fields, dims_actives, grid, plans):
         for i in fidx:
             s = vb[i].shape
             ol = dict(dims_actives[i])[d]
-            sends[i] = {(d, 0): _plane(vb[i], d, ol - 1),
-                        (d, 1): _plane(vb[i], d, s[d] - ol)}
+            reqs = [(d, ol - 1), (d, s[d] - ol)]       # send lo/hi
+            if not periodic:
+                reqs += [(d, 0), (d, s[d] - 1)]        # stale lo/hi
+            # Minor-dim planes that must materialize for a ppermute ride
+            # the pack_planes one-pass extractor, exactly like the grouped
+            # path (ADVICE r5 item 1): XLA otherwise pays one relayout per
+            # y/z plane (measured 491 vs 92 us for the 4-plane pack at
+            # 256^3 f32).  Pair-emulated dtypes keep the lazy slices — the
+            # sequential form exists for their homogeneous-graph rule, and
+            # the measured win was for 32-bit fields (pack is 32-bit-only
+            # in Mosaic anyway).
+            if (on_tpu and n > 1 and d >= 1 and vb[i].ndim == 3
+                    and not _pair_emulated(vb[i].dtype)
+                    and pack_planes_supported(s, vb[i].dtype)):
+                planes = [jnp.expand_dims(p, d)
+                          for p in pack_planes(vb[i], reqs)]
+            else:
+                planes = [_plane(vb[i], d, pos) for _, pos in reqs]
+            sends[i] = {(d, 0): planes[0], (d, 1): planes[1]}
             stales[i] = ({(d, 0): None, (d, 1): None} if periodic
-                         else {(d, 0): _plane(vb[i], d, 0),
-                               (d, 1): _plane(vb[i], d, s[d] - 1)})
+                         else {(d, 0): planes[2], (d, 1): planes[3]})
         groups: Dict[tuple, List[int]] = {}
         for i in fidx:
             P = sends[i][(d, 0)]
@@ -811,10 +833,14 @@ def _writer_dims(A, dims, grid, all_ext: bool = False):
     # tile-alignment requirements; self-wrap planes never materialize and
     # dim-0 planes are passed whole (`ext_planes_supported`).  With
     # `all_ext` (assemble_field: every plane arrives dense) wrap dims
-    # count as ext too.
+    # count as ext too.  The gate receives the FULL spec dim list and the
+    # wrap set the dispatcher will see, so its col/bx pricing runs the
+    # same `lane_dispatch` the writer does.
     ext_dims = [d for d in dd if d != 0 and (all_ext or d not in wraps)]
     if use_writer and not interp:
-        use_writer = ext_planes_supported(A.shape, A.dtype, ext_dims)
+        use_writer = ext_planes_supported(
+            A.shape, A.dtype, ext_dims, dd,
+            frozenset() if all_ext else wraps)
     return wraps, use_writer
 
 

@@ -155,7 +155,10 @@ def _kernel(T_hbm, A_hbm, out_ref, buf0, buf1, *scratch,
             c.start()
             c.wait()
 
-        @pl.when((i + 1 >= 1) & (i + 1 <= nb - 2))
+        # Prefetch the NEXT program's A slab — targets slabs 1..nb-2 only
+        # (edge programs fetch their own synchronously above), the same
+        # window convention as the ext-slab pipeline's `prefetch_next`.
+        @pl.when((i >= 0) & (i <= nb - 3))
         def _():
             pltpu.make_async_copy(A_hbm.at[pl.ds((i + 1) * bx, bx)],
                                   a2.at[1 - sl], asems2.at[1 - sl]).start()
@@ -212,8 +215,9 @@ def _kernel(T_hbm, A_hbm, out_ref, buf0, buf1, *scratch,
             c0.start(); c1.start(); c0.wait(); c1.wait()
 
     def prefetch_next(src):
-        # Targets 1..nb-2 only (edge programs fetch their own).
-        @pl.when((i + 1 >= 1) & (i + 1 <= nb - 2))
+        # Prefetch the NEXT program's slab — targets slabs 1..nb-2 only
+        # (edge programs fetch their own wrapping segments synchronously).
+        @pl.when((i >= 0) & (i <= nb - 3))
         def _():
             pltpu.make_async_copy(
                 src.at[pl.ds((i + 1) * bx - 1, bx + 2)],

@@ -56,12 +56,14 @@ physically-meaningless halo cells keep pre-step values).  On a sharded mesh
 this is the fused analog of running the XLA path with `overlap=True`.
 
 **Path selection in** :func:`fused_diffusion_steps` (fastest applicable
-wins): the K-step mega-kernel (`diffusion_mega`, every dim self-wrap,
-0.24 ms/step at 256^3) > K-step trapezoidal chunks
-(`diffusion_trapezoid`, fully-periodic rings with z self-wrap — 0.29
-ms/step on the `(N,1,1)` pod decomposition, 0.40 on `(N,M,1)` with both
-dims extended; one K-deep slab ppermute pair per exchanged dim per K
-steps) > the per-step kernel above (any mesh, 0.52 ms/step;
+wins): the K-step mega-kernel (`diffusion_mega`, 1-device grids, every
+dim self-wrap or frozen, 0.24 ms/step at 256^3) > K-step trapezoidal
+chunks (`diffusion_trapezoid`, exchanged rings/tori of ANY per-dim
+periodicity — periodic dims self-wrapped or extended, open dims extended
+with per-device edge freezing (the reference-default boundary condition)
+— 0.29 ms/step on the `(N,1,1)` pod decomposition, 0.40 on `(N,M,1)`
+with both dims extended; one K-deep slab ppermute pair per exchanged dim
+per K steps) > the per-step kernel above (any mesh, 0.52 ms/step;
 `benchmarks/results/pallas_sweep.jsonl`).
 """
 
@@ -482,19 +484,22 @@ def fused_diffusion_steps(T, Cp, *, n_inner, dx, dy, dz, dt, lam,
             return fused_diffusion_megasteps(T, A, n_inner=n_inner, bx=bx,
                                              **scal, modes=modes)
 
-    # Exchanged fully-periodic meshes — (N,1,1)/(N,M,1)/(N,M,K) rings and
-    # tori, self-wrapped or extended per dim: K-step trapezoidal chunks,
-    # one K-deep slab ppermute pair per exchanged dim per K steps, the
-    # loop fused in-kernel (see `diffusion_trapezoid`).  One per-step
-    # kernel step runs FIRST: it consumes (and replaces) whatever is in the
-    # entry halo rows exactly like every other path, establishing the
+    # Exchanged meshes — (N,1,1)/(N,M,1)/(N,M,K) rings and tori with any
+    # per-dim periodicity (periodic dims self-wrapped or extended, OPEN
+    # dims extended with per-device edge freezing — the reference-default
+    # boundary condition, round 6): K-step trapezoidal chunks, one K-deep
+    # slab ppermute pair per exchanged dim per K steps, the loop fused
+    # in-kernel (see `diffusion_trapezoid`).  One per-step kernel step
+    # runs FIRST: it consumes (and replaces) whatever is in the entry
+    # halo rows exactly like every other path, establishing the
     # exchange-fresh window state the trapezoid's validity argument
     # requires — so this path is bit-equivalent to the per-step path for
     # ANY input, including never-exchanged arrays.  Remainder steps fall
     # through to the per-step loop below.
     from .diffusion_trapezoid import (fused_diffusion_trapezoid_steps,
                                       trapezoid_supported)
-    if trapezoid_supported(grid, T.shape, bx, n_inner - 1, T.dtype):
+    if trapezoid_supported(grid, T.shape, bx, n_inner - 1, T.dtype,
+                           allow_open=True):
         T = fused_diffusion_step(T, Cp, dx=dx, dy=dy, dz=dz, dt=dt,
                                  lam=lam, bx=bx, interpret=interpret)
         n_inner -= 1

@@ -43,19 +43,34 @@ by K.
 Validity needs fresh data beyond each extended end.  Periodic rings
 provide it by neighbor slabs (`periods[d]`, any `dims[d] >= 1` — on one
 device a ring is the self-neighbor ppermute, handled by the in-kernel
-wrap).  OPEN boundaries (round 5) provide it by freezing: a no-write
-boundary row (`/root/reference/test/test_update_halo.jl:727-732`) is
-genuinely local — global-edge devices re-freeze their boundary slab from
-the chunk-entry buffer every step (uniform SPMD shapes, `axis_index`
-masks), which both preserves the frozen rows bit-for-bit and quarantines
-the beyond-domain shoulder garbage, so the validity front never shrinks
-from an open side.  The open modes (`_dim_modes`: "oext"/"frozen") are
-realized by the pure-XLA window path and pinned per-step-equivalent on
-open and mixed meshes by `tests/test_trapezoid.py::test_open_*`; the
-Mosaic chunk kernel implements the periodic modes only (per-device
-edge-freezing inside the manual-DMA pipeline is future work), so the
-compiled dispatcher keeps the per-step kernel on open grids
-(`trapezoid_supported(allow_open=False)` default).  The dispatcher in
+wrap).  OPEN boundaries provide it by freezing: a no-write boundary row
+(`/root/reference/test/test_update_halo.jl:727-732`) is genuinely local —
+global-edge devices re-freeze their boundary plane from the chunk-entry
+buffer every step (uniform SPMD shapes, `axis_index` edge flags), which
+both preserves the frozen rows bit-for-bit and quarantines the
+beyond-domain shoulder garbage, so the validity front never shrinks from
+an open side.  The open modes (`_dim_modes`: "oext"/"frozen") run in BOTH
+realizations (round 6 — the reference's examples default to non-periodic,
+so this is its default boundary condition on the compiled tier):
+
+  - the pure-XLA window path freezes the boundary plane AND the
+    beyond-domain shoulder planes from the chunk-entry buffer
+    (`_window_steps_xla`), pinned per-step-equivalent on open and mixed
+    8-device meshes by `tests/test_trapezoid.py::test_open_*`;
+  - the Mosaic chunk kernel re-freezes exactly the boundary plane per
+    open side per step, from freeze planes held VMEM-resident for the
+    whole chunk, gated by per-device `axis_index` edge flags in SMEM
+    (the same no-write semantics the open mega-kernel modes realize,
+    `diffusion_mega` "frozen").  Freezing the single boundary plane
+    suffices for central-window equality with the window realization:
+    influence from the shoulder planes can only reach the central window
+    THROUGH the frozen plane, which never reads its neighbors — so the
+    two realizations (and the per-step path) agree bit-for-bit on the
+    block, and the evolving shoulder garbage is quarantined exactly as
+    the window's explicit shoulder freeze quarantines it.
+
+The compiled dispatcher admits the open modes with
+`trapezoid_supported(..., allow_open=True)`; the dispatcher in
 `fused_diffusion_steps` also runs one per-step kernel step BEFORE the
 chunks, which consumes never-exchanged entry halos exactly like every
 other path (bit-equivalence for ANY input).
@@ -91,9 +106,10 @@ def _dim_modes(grid, force_y_ext=None, force_z_ext=None):
       - ``"frozen"`` open single device: no extension, both edge rows
                      re-frozen every step on every device.
 
-    The Mosaic chunk kernel implements only the periodic modes; the open
-    modes run in the pure-XLA window realization (see
-    `trapezoid_supported(allow_open=...)`)."""
+    Both realizations (Mosaic chunk kernel / pure-XLA window) implement
+    all four modes; open dims must be admitted explicitly via
+    `trapezoid_supported(allow_open=True)` (the compiled dispatcher
+    does)."""
     modes = []
     for d in range(3):
         if grid.periods[d]:
@@ -122,10 +138,11 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     route).
 
     `allow_open=True` additionally admits open (non-periodic) dimensions
-    — the "oext"/"frozen" window modes of `_dim_modes`, realized only by
-    the pure-XLA window path (`interpret=True`); the Mosaic kernel has no
-    per-device edge-freezing masks, so the compiled dispatcher keeps the
-    per-step kernel on open grids."""
+    — the "oext"/"frozen" modes of `_dim_modes`, realized by BOTH the
+    Mosaic chunk kernel (per-device edge-freeze planes + `axis_index`
+    flags) and the pure-XLA window path; the compiled dispatcher passes
+    it, serving the reference-default boundary condition on the K-step
+    tier.  The default stays False so direct callers opt in explicitly."""
     import numpy as np
 
     if n_inner < bx or bx < 2:
@@ -147,6 +164,10 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         return False
     if modes[0] != "frozen" and (S0 - olx - K < 0 or olx + K > S0):
         # x send slabs inside the block (no slabs in frozen mode)
+        return False
+    if modes[0] == "frozen" and S0 // bx < 2:
+        # The kernel's edge programs fetch their own clamped segments;
+        # with one program both edge branches would collide on one slot.
         return False
     if S1 % 8 != 0:
         # Mosaic requires tile-aligned VMEM memref slices of the double-
@@ -186,28 +207,54 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     need = itemsize * (S0e * S1e * S2e            # A_ext resident
                        + 2 * (bx + 2) * S1e * S2e   # ext slabs (dbl-buffered)
                        + 2 * bx * S1e * S2e)        # out slabs (dbl-buffered)
+    # Open dims keep their two freeze planes VMEM-resident for the chunk.
+    for d, plane in ((0, S1e * S2e), (1, S0e * S2e), (2, S0e * S1e)):
+        if modes[d] in ("oext", "frozen"):
+            need += 2 * itemsize * plane
     return need <= _VMEM_BUDGET
 
 
-def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
-            a_vmem, ext2, o2, esems, osems, asem,
-            *, K, bx, nbe, nbo, off, S0e, S1e, S2, y_ext, z_ext,
+def _kernel(*refs, K, bx, nbe, nbo, off, S0e, S1e, S2, modes, frz,
             rdx2, rdy2, rdz2):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    # Variadic unpacking (`frz` is the static tuple of (dim, lo, hi)
+    # freeze-plane indices — empty on fully-periodic grids, whose program
+    # carries no flags/planes/freeze scratch at all).
+    nfr = 2 * len(frz)
+    it = iter(refs)
+    Text_hbm, A_hbm = next(it), next(it)
+    flags = next(it) if frz else None          # SMEM (6,) i32 edge flags
+    fr_hbm = [next(it) for _ in range(nfr)]    # squeezed freeze planes
+    out_ref, buf0, buf1 = next(it), next(it), next(it)
+    a_vmem, ext2, o2, esems, osems, asem = (next(it) for _ in range(6))
+    fr_vmem = [next(it) for _ in range(nfr)]
+    fsems = next(it) if frz else None
 
     k = pl.program_id(0)
     i = pl.program_id(1)
     scal = (rdx2, rdy2, rdz2)
     sl = i % 2
 
-    # One-time: extended coefficient into VMEM.
+    # One-time: extended coefficient (and the chunk-invariant freeze
+    # planes of the open dims) into VMEM.
     @pl.when((k == 0) & (i == 0))
     def _():
         dma = pltpu.make_async_copy(A_hbm, a_vmem, asem)
         dma.start()
         dma.wait()
+
+    if frz:
+        @pl.when((k == 0) & (i == 0))
+        def _():
+            cs = [pltpu.make_async_copy(fr_hbm[j], fr_vmem[j], fsems.at[j])
+                  for j in range(nfr)]
+            for c in cs:
+                c.start()
+            for c in cs:
+                c.wait()
 
     # Out-write bookkeeping (identical scheme to diffusion_mega._kernel):
     # drain at each step boundary, else wait the DMA whose slot is reused.
@@ -245,7 +292,9 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
             c0.start(); c1.start(); c0.wait(); c1.wait()
 
     def prefetch_next(src):
-        @pl.when((i + 1 >= 1) & (i + 1 <= nbe - 2))
+        # Prefetch the NEXT program's slab — targets slabs 1..nbe-2 only
+        # (edge programs fetch their own clamped segments synchronously).
+        @pl.when((i >= 0) & (i <= nbe - 3))
         def _():
             pltpu.make_async_copy(
                 src.at[pl.ds((i + 1) * bx - 1, bx + 2)],
@@ -279,15 +328,51 @@ def _kernel(Text_hbm, A_hbm, out_ref, buf0, buf1,
     o_vmem[bx - 1:bx, 1:-1, 1:-1] = _u_rows(
         c[bx - 2:bx - 1], c[bx - 1:bx], ext[bx + 1:bx + 2],
         a[bx - 1:bx], *scal)
-    if not y_ext:
+    if modes[1] == "wrap":
         # y self-wrap; in extended-y mode the edge rows are shoulder cells
-        # whose (garbage) values the validity argument never reads back.
+        # whose (garbage) values the validity argument never reads back,
+        # and in frozen-y mode the edge rows are owned by the freeze below.
         o_vmem[:, 0:1, 1:-1] = o_vmem[:, S1e - 2:S1e - 1, 1:-1]
         o_vmem[:, S1e - 1:S1e, 1:-1] = o_vmem[:, 1:2, 1:-1]
-    if not z_ext:
-        # z self-wrap; ditto for extended-z shoulder lanes.
+    if modes[2] == "wrap":
+        # z self-wrap; ditto for extended-z shoulder lanes / frozen-z.
         o_vmem[:, :, 0:1] = o_vmem[:, :, S2 - 2:S2 - 1]
         o_vmem[:, :, S2 - 1:S2] = o_vmem[:, :, 1:2]
+
+    # Open-boundary edge freeze (after the wrap writes — the freeze wins
+    # the shared cells, like the per-step path's no-write planes): each
+    # open dim's boundary plane is re-written from the chunk-entry values
+    # on the devices whose `axis_index` edge flag is set ("frozen" dims
+    # set both flags on every device — one device IS both edges).  x
+    # planes belong to the single program owning that extended row; y/z
+    # planes are written band-wise by every program.
+    for j, (d, lo_i, hi_i) in enumerate(frz):
+        vlo, vhi = fr_vmem[2 * j], fr_vmem[2 * j + 1]
+        flo, fhi = flags[2 * d], flags[2 * d + 1]
+        if d == 0:
+            @pl.when((i == lo_i // bx) & (flo == 1))
+            def _(vlo=vlo, r=lo_i % bx):
+                o_vmem[r:r + 1] = vlo[...][None]
+
+            @pl.when((i == hi_i // bx) & (fhi == 1))
+            def _(vhi=vhi, r=hi_i % bx):
+                o_vmem[r:r + 1] = vhi[...][None]
+        elif d == 1:
+            @pl.when(flo == 1)
+            def _(vlo=vlo, p=lo_i):
+                o_vmem[:, p:p + 1, :] = vlo[pl.ds(i * bx, bx)][:, None, :]
+
+            @pl.when(fhi == 1)
+            def _(vhi=vhi, p=hi_i):
+                o_vmem[:, p:p + 1, :] = vhi[pl.ds(i * bx, bx)][:, None, :]
+        else:
+            @pl.when(flo == 1)
+            def _(vlo=vlo, p=lo_i):
+                o_vmem[:, :, p:p + 1] = vlo[pl.ds(i * bx, bx)][:, :, None]
+
+            @pl.when(fhi == 1)
+            def _(vhi=vhi, p=hi_i):
+                o_vmem[:, :, p:p + 1] = vhi[pl.ds(i * bx, bx)][:, :, None]
 
     # Async write-back.  Final step: the central window goes to the real
     # output; shoulder programs park their slab in the (otherwise unused)
@@ -399,17 +484,16 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
             if ext:
                 out = lax.slice_in_dim(out, K, K + So, axis=d)
         return out
-    assert modes[0] == "ext" and not any(m in ("oext", "frozen")
-                                         for m in modes), (
-        "the Mosaic chunk kernel implements only the periodic modes")
+    import jax.numpy as jnp
+
+    from ..shared import AXIS_NAMES
+
     y_ext, z_ext = extended[1], extended[2]
     if z_ext and S2e % 128 != 0:
         # Mosaic requires 128-aligned VMEM lane slices; right-pad the
         # extended lane extent with zeros.  The garbage lanes lie beyond
         # the +K extension: their invalidity front reaches exactly lane
         # K+S2o after K steps, never entering the central window.
-        import jax.numpy as jnp
-
         S2p = ((S2e + 127) // 128) * 128
         pad = [(0, 0), (0, 0), (0, S2p - S2e)]
         Text = jnp.pad(Text, pad)
@@ -418,28 +502,64 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
     assert K == bx, "chunk depth is pinned to the block row count"
     nbe = S0e // bx
     nbo = S0 // bx
-    off = 1  # = K // bx
+    off = 1 if modes[0] != "frozen" else 0   # = extension rows // bx
+
+    # Open-dim freeze config: (dim, lo, hi) boundary-plane indices in the
+    # (logical) extended buffer — `out + K` offsets for "oext", the buffer
+    # ends for "frozen" — plus the squeezed chunk-entry freeze planes and
+    # the per-device SMEM edge flags (frozen dims statically flag both
+    # sides: one device IS both global edges, and no `axis_index` is
+    # traced, so 1-device frozen grids still run under plain `jax.jit`).
+    frz = tuple((d, (K if modes[d] == "oext" else 0),
+                 out_shape3[d] + (K if modes[d] == "oext" else 0) - 1)
+                for d in range(3) if modes[d] in ("oext", "frozen"))
+    fr_planes = []
+    flag_ops = []
+    if frz:
+        for d, lo, hi in frz:
+            for idx in (lo, hi):
+                fr_planes.append(jnp.squeeze(
+                    lax.slice_in_dim(Text, idx, idx + 1, axis=d), d))
+        flag_vals = []
+        for d in range(3):
+            if modes[d] == "frozen":
+                flag_vals += [1, 1]
+            elif modes[d] == "oext":
+                ai = lax.axis_index(AXIS_NAMES[d])
+                flag_vals += [(ai == 0).astype(jnp.int32),
+                              (ai == grid.dims[d] - 1).astype(jnp.int32)]
+            else:
+                flag_vals += [0, 0]
+        flag_ops = [jnp.stack([jnp.asarray(v, jnp.int32)
+                               for v in flag_vals])]
+
     kern = partial(_kernel, K=K, bx=bx, nbe=nbe, nbo=nbo, off=off,
-                   S0e=S0e, S1e=S1e, S2=S2e, y_ext=y_ext, z_ext=z_ext,
+                   S0e=S0e, S1e=S1e, S2=S2e, modes=tuple(modes), frz=frz,
                    rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
 
+    operands = [Text, A_ext, *flag_ops, *fr_planes]
     vmas = [getattr(getattr(x, "aval", None), "vma", None)
-            for x in (Text, A_ext)]
+            for x in operands]
     vma = frozenset().union(*[v for v in vmas if v])
 
     def shp(s):
         return (jax.ShapeDtypeStruct(s, Text.dtype, vma=vma) if vma
                 else jax.ShapeDtypeStruct(s, Text.dtype))
 
+    fr_scratch = [pltpu.VMEM(p.shape, Text.dtype) for p in fr_planes]
+    if frz:
+        fr_scratch.append(pltpu.SemaphoreType.DMA((len(fr_planes),)))
     out, _, _ = pl.pallas_call(
         kern,
         grid=(K, nbe),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+                  pl.BlockSpec(memory_space=pl.ANY)]
+        + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(flag_ops)
+        + [pl.BlockSpec(memory_space=pl.ANY)] * len(fr_planes),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_shape=[shp((S0, S1e, S2e)), shp(Text.shape), shp(Text.shape)],
-        # Text is dead after the k=0 reads; buf1 (first written at k=1)
-        # reuses its buffer.
+        # Text is dead after the k=0 reads (the freeze planes are their
+        # own buffers); buf1 (first written at k=1) reuses its buffer.
         input_output_aliases={0: 2},
         scratch_shapes=[
             pltpu.VMEM(Text.shape, Text.dtype),             # a_vmem
@@ -448,11 +568,11 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
             pltpu.SemaphoreType.DMA((2,)),                  # esems
             pltpu.SemaphoreType.DMA((2,)),                  # osems
             pltpu.SemaphoreType.DMA,                        # asem
-        ],
+        ] + fr_scratch,                                     # fr_vmem, fsems
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=128 * 1024 * 1024,
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(Text, A_ext)
+    )(*operands)
     if y_ext:
         # Central y window (tile-aligned K offset: a cheap slab slice).
         out = lax.slice_in_dim(out, K, K + S1o, axis=1)

@@ -153,7 +153,27 @@ def _dtype_ok(dtype, interpret: bool) -> bool:
     return True
 
 
-def ext_planes_supported(shape, dtype, ext_dims) -> bool:
+def lane_dispatch(shape, dtype, dims, wraps) -> Tuple[bool, int]:
+    """THE dirty-column-vs-one-pass dispatch decision for lane-active halo
+    sets — returns `(use_col, bx)`: whether the two-dirty-column chain
+    serves the set, and the x-block row count the lane-dim writer tiles
+    with (picked against one 128-lane column on the dirty-column path,
+    against the full block on the one-pass path).  Single source consumed
+    by BOTH the runtime dispatcher (`write_lane_active`) and the engine
+    gate (`ext_planes_supported`), so the gate provably prices the block
+    shapes the writer will actually emit — previously the two sides
+    duplicated these conditions and agreed only by accident (ADVICE r5
+    item 2)."""
+    import numpy as np
+
+    n0, n1, n2 = shape
+    col = lane_columns_writable(shape, dtype, dims, wraps)
+    return col, _pick_bx(n0, n1, 128 if col else n2,
+                         np.dtype(dtype).itemsize)
+
+
+def ext_planes_supported(shape, dtype, ext_dims, dims=None,
+                         wraps=frozenset()) -> bool:
     """Whether Mosaic accepts the writers' partial-grid BlockSpecs for the
     received (ext) planes of `ext_dims`: a plane array's own trailing dim
     must be 128-lane aligned when the writer tiles it with a partial
@@ -165,7 +185,12 @@ def ext_planes_supported(shape, dtype, ext_dims) -> bool:
     Staggered fields (`n+1` extents) with exchanged sublane/lane dims fail
     this — caught by the round-5 v5p-64 AOT schedule study, where the
     Stokes overlap program crashed Mosaic lowering — and take the XLA
-    plans instead."""
+    plans instead.
+
+    `dims`/`wraps` are the FULL spec dim list and wrap set the runtime
+    dispatcher will see (they feed the shared :func:`lane_dispatch`, so
+    the bx priced here is the bx the writer uses); `dims` defaults to
+    `ext_dims` for callers without wrap-mode dims."""
     import numpy as np
 
     n0, n1, n2 = shape
@@ -184,15 +209,11 @@ def ext_planes_supported(shape, dtype, ext_dims) -> bool:
     if 1 in ext_dims:
         ok = ok and n2 % 128 == 0 and bx_ok(_pick_bx(n0, n1, n2, itemsize))
     if 2 in ext_dims:
-        # The exchanged-lane write runs `_write_dim2` (bx picked against a
-        # 128-lane column) when the dirty-column conditions hold, the
-        # one-pass writer (bx against the full block) otherwise — mirror
-        # that dispatch exactly (`write_lane_active`).
-        col = (n2 % 128 == 0 and n2 >= 3 * 128
-               and slab_write_supported(shape, dtype,
-                                        [d for d in ext_dims if d != 2]))
-        bx2 = (_pick_bx(n0, n1, 128, itemsize) if col
-               else _pick_bx(n0, n1, n2, itemsize))
+        # The exchanged-lane write runs `_write_dim2` when the dirty-column
+        # conditions hold, the one-pass writer otherwise; the decision AND
+        # the bx come from the same helper the dispatch consumes.
+        _, bx2 = lane_dispatch(shape, dtype,
+                               ext_dims if dims is None else dims, wraps)
         ok = ok and n1 % 128 == 0 and bx_ok(bx2)
     return ok
 
@@ -358,7 +379,7 @@ def _write_dim1(A, spec, *, interpret: bool):
         alias=alias, args=args, interpret=interpret)
 
 
-def _write_dim2(A, zspec, *, interpret: bool):
+def _write_dim2(A, zspec, *, bx: int = None, interpret: bool):
     """In-place RMW of the two outer lane-dim planes touching ONLY the two
     dirty 128-lane tile columns (`2*128/n2` of the block, vs the one-pass
     writer's full RMW).  Received dense planes only — self-wrap sources
@@ -373,7 +394,8 @@ def _write_dim2(A, zspec, *, interpret: bool):
     from jax.experimental import pallas as pl
 
     n0, n1, n2 = A.shape
-    bx = _pick_bx(n0, n1, 128, np.dtype(A.dtype).itemsize)
+    if bx is None:  # standalone use; the engine passes lane_dispatch's bx
+        bx = _pick_bx(n0, n1, 128, np.dtype(A.dtype).itemsize)
     ncols = n2 // 128
     paired = zspec[1] == "ext2"
     planes = zspec[2:6] if paired else zspec[2:4]
@@ -448,12 +470,12 @@ def _write_lane_active_raw(A, specs, wraps, *, interpret: bool = False):
     lane = A.ndim - 1
     zspec = [sp for sp in specs if sp[0] == lane]
     dims = [sp[0] for sp in specs]
-    if (zspec and zspec[0][1] in ("ext", "ext2")
-            and lane_columns_writable(A.shape, A.dtype, dims, wraps)):
+    use_col, bx = lane_dispatch(A.shape, A.dtype, dims, wraps)
+    if zspec and zspec[0][1] in ("ext", "ext2") and use_col:
         rest = [sp for sp in specs if sp[0] != lane]
         B = (_halo_write_slabs_raw(A, rest, interpret=interpret)
              if rest else A)
-        return _write_dim2(B, zspec[0], interpret=interpret)
+        return _write_dim2(B, zspec[0], bx=bx, interpret=interpret)
     return _halo_write_raw(A, specs, interpret=interpret)
 
 

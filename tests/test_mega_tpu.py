@@ -206,6 +206,120 @@ def test_trapezoid_3d_kernel_matches_window():
 
 
 @pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+@pytest.mark.parametrize("periods", [(0, 0, 0), (0, 1, 1), (1, 0, 1),
+                                     (1, 1, 0)])
+def test_trapezoid_open_modes_match_per_step_kernel(periods):
+    """Round 6: the open-boundary (frozen-edge) chunk kernel modes vs 2K
+    applications of the per-step fused kernel — the reference-default
+    boundary condition on the compiled K-step tier.  On one chip the open
+    dims run "frozen" (periodic dims "ext"/"wrap"), exercising the
+    edge-freeze planes, the SMEM flags, and the off=0 frozen-x program
+    layout; the multi-device "oext" flag gating is pinned on the 8-device
+    interpret meshes (tests/test_trapezoid.py::test_open_*) and by
+    test_trapezoid_oext_kernel_matches_window below."""
+    import jax
+    import jax.numpy as jnp
+
+    from igg.models import diffusion3d as d3
+    from igg.ops import fused_diffusion_step
+    from igg.ops.diffusion_trapezoid import (
+        fused_diffusion_trapezoid_steps, trapezoid_supported)
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    # Exchange-fresh entry state (frozen dims need nothing; wrap/ext dims
+    # need their self-wrap halos fresh, like every trapezoid entry).
+    T = igg.update_halo(T)
+    dx, dy, dz = params.spacing()
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+    A = float(params.timestep() * params.lam) / Cp
+    bx = 8
+    assert trapezoid_supported(grid, T.shape, bx, 2 * bx, T.dtype,
+                               allow_open=True)
+
+    out, done = jax.jit(
+        lambda T, A: fused_diffusion_trapezoid_steps(
+            T, A, n_inner=2 * bx, bx=bx, grid=grid, **scal))(T, A)
+    assert done == 2 * bx
+
+    dt = params.timestep()
+    ref = T
+    step = jax.jit(lambda T: fused_diffusion_step(
+        T, Cp, dx=dx, dy=dy, dz=dz, dt=dt, lam=params.lam, bx=bx))
+    for _ in range(2 * bx):
+        ref = step(ref)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(out - ref))) <= 4e-7 * scale
+    # Frozen boundary planes must match BITWISE: both paths leave them
+    # untouched (no-write), so they carry the entry values exactly.
+    outn, refn, Tn = np.asarray(out), np.asarray(ref), np.asarray(T)
+    for d, p in enumerate(periods):
+        if p:
+            continue
+        for edge in (slice(0, 1), slice(-1, None)):
+            sl = [slice(None)] * 3
+            sl[d] = edge
+            assert np.array_equal(outn[tuple(sl)], refn[tuple(sl)]), d
+            assert np.array_equal(outn[tuple(sl)], Tn[tuple(sl)]), d
+    igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_trapezoid_oext_kernel_matches_window():
+    """Round 6: the multi-device open program shape ("oext" — extended by
+    non-wrapping permutes, global-edge devices re-freeze their boundary
+    plane from SMEM-flag-gated VMEM freeze planes) against the pure-XLA
+    window realization on the same extended buffer.  On the 1-chip mesh
+    the single device is BOTH global edges, so both freeze planes and
+    both `axis_index` flags are exercised; the window realization is
+    itself pinned per-step-equivalent on 8-device open meshes by
+    tests/test_trapezoid.py."""
+    import jax.numpy as jnp
+
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_trapezoid import _chunk_call, _extend
+
+    igg.init_global_grid(64, 64, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=0, periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = d3.Params()
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    T = igg.update_halo(T)
+    dx, dy, dz = params.spacing()
+    scal = dict(rdx2=1.0 / (dx * dx), rdy2=1.0 / (dy * dy),
+                rdz2=1.0 / (dz * dz))
+    A = float(params.timestep() * params.lam) / Cp
+    K = bx = 8
+    modes = ("oext", "ext", "wrap")
+    shape = T.shape
+
+    @igg.sharded
+    def kernel_chunk(T, A):
+        Text = _extend(T, K, grid, shape, modes)
+        A_ext = _extend(A, K, grid, shape, modes)
+        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, modes=modes,
+                           grid=grid, **scal)
+
+    @igg.sharded
+    def window_chunk(T, A):
+        Text = _extend(T, K, grid, shape, modes)
+        A_ext = _extend(A, K, grid, shape, modes)
+        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, modes=modes,
+                           grid=grid, **scal, interpret=True)
+
+    out = np.asarray(kernel_chunk(T, A))
+    ref = np.asarray(window_chunk(T, A))
+    scale = max(abs(ref).max(), 1e-30)
+    assert abs(out - ref).max() <= 4e-7 * scale
+    igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
 def test_stokes_kernel_compiled_matches_xla():
     """Round 4: the mesh-capable fused Stokes kernel COMPILED on the chip
     (engine-routed x planes, staggered per-field halo modes) vs the XLA

@@ -1,0 +1,180 @@
+"""Round-6 satellite coverage: the sequential-exchange pack fast path
+(ADVICE r5 item 1), the shared lane-dispatch helper behind
+`ext_planes_supported` (item 2), and the `igg.sharded` identity-keyed
+cache-miss log (VERDICT r5 weak #5)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import igg
+
+
+def _spy_pack(calls):
+    """A `pack_planes` stand-in recording requests and returning the exact
+    squeezed planes XLA slicing would produce, so the patched program's
+    values match the unpatched oracle."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def pack(A, reqs):
+        calls.append(tuple(reqs))
+        return [jnp.squeeze(lax.slice_in_dim(A, p, p + 1, axis=d), d)
+                for d, p in reqs]
+
+    return pack
+
+
+def _seq_update(T, grid):
+    from igg import halo
+
+    da = halo.active_dims(T.shape, grid)
+    dims = halo.moving_dims(da, grid)
+    return halo.exchange_assemble_sequential(
+        [T], [dims], grid, ["select"])[0]
+
+
+def test_sequential_exchange_uses_pack_fast_path(monkeypatch):
+    """On (virtually) TPU meshes, `exchange_assemble_sequential` must route
+    eligible 32-bit minor-dim sends — including the open-boundary stale
+    planes, which materialize for the wire's masked select — through the
+    `pack_planes` one-pass extractor, and keep major-dim (x) planes lazy."""
+    import jax.numpy as jnp
+
+    from igg import halo
+    from igg.ops import pack
+
+    igg.init_global_grid(16, 16, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=0, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    rng = np.random.default_rng(3)
+    T0 = igg.from_local_blocks(
+        lambda coords, ls: rng.standard_normal(ls).astype(np.float32),
+        (16, 16, 16))
+
+    ref = np.asarray(igg.sharded(lambda T: _seq_update(T, grid))(T0))
+
+    calls = []
+    monkeypatch.setattr(halo, "_is_tpu", lambda g: True)
+    monkeypatch.setattr(pack, "pack_planes", _spy_pack(calls))
+
+    out = np.asarray(
+        igg.sharded(lambda T: _seq_update(T, grid) + 0)(T0))
+
+    # d=1 is open: 2 sends + 2 stales in one pass; d=2 periodic: 2 sends.
+    # d=0 (major) never packs — its planes are free lazy slices.  Local
+    # blocks are 16^3 (init sizes are per-device).
+    assert tuple((1, p) for p in (1, 14, 0, 15)) in calls
+    assert tuple((2, p) for p in (1, 14)) in calls
+    assert not any(d == 0 for req in calls for d, _ in req)
+    # The spy returns the genuine planes, so values must match the oracle.
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sequential_exchange_keeps_lazy_slices_for_pair_dtypes(monkeypatch):
+    """Pair-emulated dtypes (f64 — the homogeneous-graph rule's domain)
+    must NOT take the pack path (ADVICE r5: keep the sequential form where
+    it was measured to win; pack is 32-bit-only in Mosaic)."""
+    from igg import halo
+    from igg.ops import pack
+
+    igg.init_global_grid(16, 16, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=0, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    T0 = igg.from_local_blocks(
+        lambda coords, ls: np.full(ls, coords[1], np.float64), (16, 16, 16),
+        dtype=np.float64)
+
+    calls = []
+    monkeypatch.setattr(halo, "_is_tpu", lambda g: True)
+    monkeypatch.setattr(pack, "pack_planes", _spy_pack(calls))
+
+    igg.sharded(lambda T: _seq_update(T, grid))(T0)
+    assert calls == []
+
+
+def test_ext_planes_gate_matches_lane_dispatch():
+    """`ext_planes_supported` must price exactly the dispatch decision the
+    runtime takes: the col-vs-one-pass verdict and the bx it aligns come
+    from the shared `lane_dispatch` helper, across the block-shape matrix
+    (ADVICE r5 item 2 — the gate and `write_lane_active` previously
+    duplicated these conditions and agreed only by accident)."""
+    import jax.numpy as jnp
+
+    from igg.ops.halo_write import (_pick_bx, _sublane_tile,
+                                    ext_planes_supported, lane_dispatch,
+                                    lane_columns_writable)
+
+    shapes = [(256, 256, 256), (256, 256, 512), (64, 64, 128),
+              (65, 64, 128), (64, 64, 384), (32, 8, 128),
+              (256, 256, 384), (33, 256, 384), (64, 257, 384),
+              (64, 128, 129)]
+    dtypes = [np.dtype(np.float32), np.dtype(jnp.bfloat16)]
+    dim_sets = [[2], [1, 2], [0, 1, 2], [0, 2]]
+    wrap_sets = [frozenset(), frozenset({1})]
+
+    for shape in shapes:
+        n0, n1, n2 = shape
+        for dtype in dtypes:
+            itemsize = dtype.itemsize
+            ts = _sublane_tile(itemsize)
+            for dims in dim_sets:
+                for wraps in wrap_sets:
+                    col, bx = lane_dispatch(shape, dtype, dims, wraps)
+                    # The helper's verdict IS the runtime's: col comes from
+                    # lane_columns_writable, bx from the block the writer
+                    # tiles (one 128-lane column on the col path, the full
+                    # block on the one-pass path).
+                    assert col == lane_columns_writable(shape, dtype, dims,
+                                                        wraps)
+                    assert bx == _pick_bx(n0, n1, 128 if col else n2,
+                                          itemsize)
+                    # And the gate's lane-dim branch prices exactly that
+                    # bx: recompute its verdict from the helper and compare.
+                    ext_dims = [d for d in dims if d != 0
+                                and d not in wraps]
+                    expect = True
+                    if any(d in ext_dims for d in (1, 2)):
+                        if 1 in ext_dims:
+                            expect = (expect and n2 % 128 == 0
+                                      and (_pick_bx(n0, n1, n2, itemsize)
+                                           in (n0,)
+                                           or _pick_bx(n0, n1, n2,
+                                                       itemsize) % ts == 0))
+                        if 2 in ext_dims:
+                            expect = (expect and n1 % 128 == 0
+                                      and (bx == n0 or bx % ts == 0))
+                    got = ext_planes_supported(shape, dtype, ext_dims,
+                                               dims, wraps)
+                    assert got == expect, (shape, dtype, dims, wraps)
+
+
+def test_sharded_identity_cache_miss_logs(caplog):
+    """A closure over unhashable captures is cache-keyed by object identity;
+    the first compiled-cache miss must emit the debug-level retrace warning
+    (once per function), and hashable-capture closures must stay silent
+    (VERDICT r5 weak #5)."""
+    igg.init_global_grid(16, 16, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T = igg.zeros((16, 16, 16), dtype=np.float32)
+
+    def make_unhashable_step(c):
+        def step(T):
+            return T + float(c[0])
+        return step
+
+    def make_hashable_step(k):
+        def step(T):
+            return T + k
+        return step
+
+    arr = np.asarray([1.5])  # numpy captures are unhashable
+    with caplog.at_level(logging.DEBUG, logger="igg.parallel"):
+        igg.sharded(make_unhashable_step(arr))(T)
+    assert any("object identity" in r.message for r in caplog.records)
+
+    caplog.clear()
+    with caplog.at_level(logging.DEBUG, logger="igg.parallel"):
+        igg.sharded(make_hashable_step(1.5))(T)
+    assert not any("object identity" in r.message for r in caplog.records)

@@ -302,6 +302,8 @@ def _chunk_vs_per_step_open(mesh, periods, K=8, shape=(16, 16, 128)):
                          periodz=periods[2], quiet=True)
     grid = igg.get_global_grid()
     scal = dict(rdx2=0.3, rdy2=0.25, rdz2=0.2)
+    # allow_open=True is what the compiled dispatcher passes (round 6);
+    # the conservative default still rejects open dims for direct callers.
     assert trapezoid_supported(grid, shape, K, K, np.float32,
                                allow_open=True)
     assert not trapezoid_supported(grid, shape, K, K, np.float32)
@@ -334,6 +336,36 @@ def _chunk_vs_per_step_open(mesh, periods, K=8, shape=(16, 16, 128)):
     np.testing.assert_allclose(out, ref, rtol=0, atol=1e-12)
     igg.finalize_global_grid()
     return _dim_modes(grid)
+
+
+def test_model_path_interpret_open_mesh():
+    """The compiled dispatcher (round 6) admits OPEN meshes to the chunk
+    tier: `fused_diffusion_steps` must route an open (8,1,1) CPU mesh —
+    the reference's default boundary condition — through the trapezoid
+    chunking (XLA window fallback in interpret mode) and match the plain
+    XLA multi-step path."""
+    import igg
+    from igg.models import diffusion3d as d3
+    from igg.ops.diffusion_trapezoid import _dim_modes, trapezoid_supported
+
+    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                         periodx=0, periody=0, periodz=0, quiet=True)
+    grid = igg.get_global_grid()
+    assert _dim_modes(grid) == ("oext", "frozen", "frozen")
+    params = d3.Params(lx=8.0, ly=8.0, lz=60.0)
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    n_inner = 9  # warm-up step + one K=8 chunk
+    assert trapezoid_supported(grid, (16, 16, 128), 8, n_inner - 1,
+                               np.float32, allow_open=True)
+
+    ref_step = d3.make_multi_step(n_inner, params, use_pallas=False,
+                                  donate=False)
+    pal_step = d3.make_multi_step(n_inner, params, use_pallas=True,
+                                  pallas_interpret=True, donate=False, bx=8)
+    ref = np.asarray(ref_step(T, Cp), np.float64)
+    out = np.asarray(pal_step(T, Cp), np.float64)
+    scale = max(abs(ref).max(), 1e-30)
+    assert abs(out - ref).max() <= 4e-6 * scale
 
 
 def test_open_x_window_chunk():
