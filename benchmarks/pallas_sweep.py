@@ -150,6 +150,82 @@ def main():
                      donate_argnums=0)
         measure("perstep_open_bx16", fn, T, n_inner)
 
+    if platform == "tpu":
+        # K-iteration Stokes trapezoid chunk tier (round 7) at 128^3 —
+        # the VMEM-admissible headline size (the resident working set
+        # gates 160^3+ out; docs/stokes_roofline.md carries the K-bound
+        # accounting).  Rows: the per-iteration fused kernel baseline
+        # (the 0.143 ms/iter tier) and the chunk kernel's steady-state
+        # chunk rate over a K sweep, periodic self-wrap AND all-open
+        # (frozen velocity boundary planes).
+        from jax import lax
+
+        from igg.models import stokes3d
+        from igg.ops import fused_stokes_iteration
+        from igg.ops.stokes_trapezoid import (
+            fused_stokes_trapezoid_iters, stokes_trapezoid_supported)
+        from igg.timing import time_steps as _ts
+
+        igg.finalize_global_grid()
+        ns = 128
+        sparams = stokes3d.Params()
+        for bc, periods in (("", (1, 1, 1)), ("open_", (0, 0, 0))):
+            igg.init_global_grid(ns, ns, ns, dimx=1, dimy=1, dimz=1,
+                                 periodx=periods[0], periody=periods[1],
+                                 periodz=periods[2], overlapx=3,
+                                 overlapy=3, overlapz=3, quiet=True)
+            grid = igg.get_global_grid()
+            kwp = stokes3d._pseudo_steps(sparams)
+
+            def fresh_stokes():
+                # Overlap-consistent nontrivial entry (the chunk tier's
+                # contract): the coordinate init evolved a few kernel
+                # iterations.
+                P, Vx, Vy, Vz, Rho = stokes3d.init_fields(
+                    sparams, dtype=np.float32)
+                pre = stokes3d.make_iteration(sparams, donate=False,
+                                              n_inner=3, trapezoid=False)
+                return (*pre(P, Vx, Vy, Vz, Rho), Rho)
+
+            def smeasure(tag, fn, state, iters):
+                _, sec = _ts(fn, state, n1=nt, n2=3 * nt)
+                sec /= iters
+                emit({
+                    "metric": "pallas_sweep_ms_per_step",
+                    "config": tag, "local": ns,
+                    "value": round(sec * 1e3, 4), "unit": "ms",
+                    "platform": platform,
+                })
+
+            state = fresh_stokes()
+            periter = jax.jit(
+                lambda P, Vx, Vy, Vz, Rho: (*lax.fori_loop(
+                    0, n_inner,
+                    lambda _, S: fused_stokes_iteration(*S, Rho, **kwp),
+                    (P, Vx, Vy, Vz)), Rho),
+                donate_argnums=(0, 1, 2, 3))
+            smeasure(f"stokes_{bc}periter_fused", periter, state, n_inner)
+
+            for Kc in (4, 8):
+                if not stokes_trapezoid_supported(grid, (ns, ns, ns), Kc,
+                                                  n_inner, np.float32):
+                    note(f"stokes_trapezoid {bc}K={Kc}: unsupported at "
+                         f"{ns}^3")
+                    continue
+                steps = (n_inner // Kc) * Kc
+                fn = jax.jit(
+                    lambda P, Vx, Vy, Vz, Rho, Kc=Kc, s=steps:
+                    (*fused_stokes_trapezoid_iters(
+                        P, Vx, Vy, Vz, Rho, n_inner=s, K=Kc,
+                        **kwp)[:4], Rho),
+                    donate_argnums=(0, 1, 2, 3))
+                smeasure(f"stokes_trapezoid_{bc}K{Kc}", fn,
+                         fresh_stokes(), steps)
+            igg.finalize_global_grid()
+        # The every-platform section below opens with finalize; leave a
+        # grid initialized for it (its contents are never read).
+        igg.init_global_grid(n, n, n, quiet=True)
+
     # Every platform: the open-boundary chunk path's XLA window
     # realization (interpret mode — same gates, same chunked structure) at
     # a fixed small shape, so the CI bench smoke always carries one
@@ -182,6 +258,44 @@ def main():
         "metric": "pallas_sweep_ms_per_step",
         "config": "trapezoid_open_interpret_bx8", "local": 16,
         "value": round(sec / bx * 1e3, 4), "unit": "ms",
+        "platform": platform,
+    })
+    igg.finalize_global_grid()
+
+    # Ditto for the Stokes chunk tier (round 7): the window realization of
+    # one K=4 chunk on an open overlap-3 grid, emitted on EVERY platform
+    # so the CI smoke always carries a stokes_trapezoid row.
+    from igg.models import stokes3d
+    from igg.ops.stokes_trapezoid import (fused_stokes_trapezoid_iters
+                                          as _straps,
+                                          stokes_trapezoid_supported
+                                          as _strap_ok)
+
+    igg.init_global_grid(16, 16, 128, overlapx=3, overlapy=3, overlapz=3,
+                         quiet=True)   # all dims open
+    grid = igg.get_global_grid()
+    Ks = 4
+    assert _strap_ok(grid, (16, 16, 128), Ks, Ks, np.float32,
+                     interpret=True)
+    sparams = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    skw = stokes3d._pseudo_steps(sparams)
+    sP, sVx, sVy, sVz, sRho = stokes3d.init_fields(sparams,
+                                                   dtype=np.float32)
+    pre = stokes3d.make_iteration(sparams, donate=False, n_inner=2,
+                                  use_pallas=False)
+    sP, sVx, sVy, sVz = pre(sP, sVx, sVy, sVz, sRho)
+    step_chunk = igg.sharded(
+        lambda P, Vx, Vy, Vz, Rho: _straps(P, Vx, Vy, Vz, Rho,
+                                           n_inner=Ks, K=Ks, **skw,
+                                           interpret=True)[:4],
+        donate_argnums=(0, 1, 2, 3))
+    _, sec = time_steps(
+        lambda P, Vx, Vy, Vz, Rho: (*step_chunk(P, Vx, Vy, Vz, Rho), Rho),
+        (sP, sVx, sVy, sVz, sRho), n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "stokes_trapezoid_open_interpret_K4", "local": 16,
+        "value": round(sec / Ks * 1e3, 4), "unit": "ms",
         "platform": platform,
     })
     igg.finalize_global_grid()

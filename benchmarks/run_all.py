@@ -87,6 +87,11 @@ def main():
     # with a note where no TPU toolchain exists).
     r("overlap_schedule.py", [] if not quick else [64],
       tag="overlap_schedule")
+    # Cost-model calibration: predicted compute_s_per_step vs the measured
+    # single-chip step time per program family, with a relative-error
+    # column (error bars for the predicted weak-scaling efficiencies).
+    r("cost_model_calibration.py", [] if not quick else [64, 3],
+      tag="cost_model_calibration")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).  64^3 for
     # weak scaling = compute-dominated (see benchmarks/README.md for how to

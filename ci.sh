@@ -30,6 +30,19 @@ else
     exit 1
 fi
 
+# Ditto for the Stokes K-iteration chunk tier (round 7): pallas_sweep
+# emits its window-realization smoke row unconditionally on every
+# platform (tests: tests/test_stokes_trapezoid.py — interpret-mode mesh
+# equivalence, dispatch admission, banded-kernel-scheme simulation —
+# plus tests/test_models.py::test_stokes_trapezoid_dispatch_admission).
+if grep -q "stokes_trapezoid" benchmarks/results_smoke/pallas_sweep.jsonl; then
+    echo "    Stokes chunk-tier smoke row PRESENT (pallas_sweep.jsonl)"
+else
+    echo "    Stokes chunk-tier smoke row MISSING from"
+    echo "    benchmarks/results_smoke/pallas_sweep.jsonl"
+    exit 1
+fi
+
 # Compiled-mode TPU kernel tests (VERDICT r3 weak item 4): run
 # unconditionally — the tests' own per-test gate (the single source of
 # TPU detection) skips them cleanly on chipless hosts, and the summary
@@ -37,7 +50,10 @@ fi
 # skipping chip cannot read as a green kernel suite.  The file includes
 # the round-6 open-boundary chunk tests
 # (test_trapezoid_open_modes_match_per_step_kernel,
-# test_trapezoid_oext_kernel_matches_window).
+# test_trapezoid_oext_kernel_matches_window) and the round-7 Stokes
+# chunk-tier test (test_stokes_trapezoid_matches_per_iteration —
+# compiled VMEM-resident banded kernel vs the per-iteration fused
+# kernel, periodic and open).
 echo "=== compiled-mode TPU kernel tests incl. open-boundary chunks"
 echo "    (skip cleanly without a chip) ==="
 IGG_TPU_TESTS=1 python -m pytest tests/test_mega_tpu.py -q -rs \

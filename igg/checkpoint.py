@@ -40,6 +40,10 @@ __all__ = ["save_checkpoint", "load_checkpoint"]
 
 _META_KEY = "__igg_meta__"
 
+# One-time memory-cliff warning flag (multi-controller checkpoint
+# materializes every field's global array on every process).
+_warned_ckpt_cliff = False
+
 
 def _meta(grid) -> dict:
     return {
@@ -87,6 +91,21 @@ def save_checkpoint(path, /, **fields) -> None:
     grid = shared.global_grid()
     if not fields:
         raise GridError("save_checkpoint: no fields given.")
+
+    global _warned_ckpt_cliff
+    if jax.process_count() > 1 and not _warned_ckpt_cliff:
+        import warnings
+
+        _warned_ckpt_cliff = True
+        total = sum(int(getattr(A, "nbytes", 0)) for A in fields.values())
+        warnings.warn(
+            f"igg.save_checkpoint: on a multi-controller run every "
+            f"process materializes the full global array of every field "
+            f"(~{total / 2**20:.0f} MiB total here) in host memory "
+            f"simultaneously — the allgather memory cliff documented in "
+            f"docs/multihost.md.  Checkpoint fewer fields per call, or "
+            f"space out the cadence, if hosts are memory-tight.  (Warned "
+            f"once per process.)", stacklevel=2)
 
     host: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}

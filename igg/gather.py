@@ -69,6 +69,10 @@ def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
 # `/root/reference/src/gather.jl:43-49`, is played by bounded staging here).
 _CHUNK_BYTES = 1 << 28  # 256 MB
 
+# One-time memory-cliff warning flag: the multi-host allgather fallback
+# materializes the full global array on EVERY process (docs/multihost.md).
+_warned_allgather = False
+
 
 def _fetch_global(A, chunk_bytes: Optional[int] = None) -> np.ndarray:
     """Device→host fetch of a (possibly multi-host) grid array.  On a
@@ -89,6 +93,21 @@ def _fetch_global(A, chunk_bytes: Optional[int] = None) -> np.ndarray:
                 out[i0:i1] = np.asarray(jax.device_get(A[i0:i1]))
             return out
         return np.asarray(jax.device_get(A))
+    global _warned_allgather
+    if not _warned_allgather:
+        import warnings
+
+        _warned_allgather = True
+        nbytes = int(getattr(A, "nbytes", 0))
+        warnings.warn(
+            f"igg.gather: multi-host arrays fall back to "
+            f"process_allgather(tiled=True), which materializes the FULL "
+            f"global array (~{nbytes / 2**20:.0f} MiB here) in host memory "
+            f"on EVERY process — not just the root.  This is the "
+            f"per-process memory cliff documented in docs/multihost.md; "
+            f"gather a sliced/subsampled field, or space out "
+            f"gather/checkpoint cadence, to stay under it.  (Warned once "
+            f"per process.)", stacklevel=3)
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(A, tiled=True))
 

@@ -175,6 +175,17 @@ _PALLAS_REQ = (
     "windows fit the VMEM budget (igg.ops.stokes_pallas._vmem_need); use "
     "the XLA path otherwise.")
 
+_TRAPEZOID_REQ = (
+    "the K-iteration Stokes chunk tier requires the fused per-iteration "
+    "kernel's prerequisites (TPU devices or pallas_interpret=True, "
+    "overlap-3 grid, f32 fields) plus: n_inner >= K+1 (one warm-up "
+    "iteration + at least one full chunk), tile-aligned local shape "
+    "(x % 8 == 0, y % 8 == 0, z % 128 == 0), 2K-deep send slabs inside "
+    "every split dimension's block, and a VMEM-resident working set for "
+    "the five 2K-extended fields "
+    "(igg.ops.stokes_trapezoid.stokes_trapezoid_supported); use "
+    "trapezoid='auto' or the per-iteration kernel otherwise.")
+
 
 def _pallas_applicable(use_pallas, P, interpret: bool = False) -> bool:
     from igg.ops import stokes_pallas_supported
@@ -198,7 +209,8 @@ def _pseudo_steps(params: Params):
 
 def make_iteration(params: Params = Params(), *, donate: bool = True,
                    overlap: bool = False, n_inner: int = 1,
-                   use_pallas="auto", pallas_interpret: bool = False):
+                   use_pallas="auto", pallas_interpret: bool = False,
+                   trapezoid="auto", K: int = None):
     """Compiled `(P, Vx, Vy, Vz, Rho) -> (P, Vx, Vy, Vz)` advancing
     `n_inner` iterations in one SPMD program.  `use_pallas`: "auto"
     (default) uses the fused kernel when it applies — TPU devices,
@@ -206,7 +218,16 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     the portable shard_map/XLA path; True requires the kernel and raises if
     inapplicable.  `overlap` restructures the XLA path with
     `igg.hide_communication`; the fused kernel has overlap semantics built
-    in, so it satisfies both settings."""
+    in, so it satisfies both settings.
+
+    `trapezoid` admits the K-iteration temporal-blocking chunk tier
+    (`igg.ops.stokes_trapezoid`) on top of the fused kernel: "auto"
+    (default) engages it when `stokes_trapezoid_supported` admits some K
+    (one warm-up per-iteration kernel, `(n_inner-1) // K` chunks, the
+    remainder through the per-iteration kernel); False pins the
+    per-iteration kernel; True requires the chunk tier and raises
+    `GridError` when inapplicable.  `K` overrides the auto-fitted chunk
+    depth (`fit_stokes_K`)."""
     from jax import lax
 
     kw = _pseudo_steps(params)
@@ -235,16 +256,54 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
         wrap=lambda fn: lambda P, Vx, Vy, Vz, Rho: (*fn(P, Vx, Vy, Vz, Rho),
                                                     Rho))
 
+    if trapezoid is True and use_pallas is False:
+        raise igg.GridError(_TRAPEZOID_REQ)
+    if trapezoid is True:
+        use_pallas = True    # the chunk tier rides the fused kernel
+
     def build_pallas_steps():
         from igg.ops import fused_stokes_iteration
+        from igg.ops.stokes_trapezoid import (fit_stokes_K,
+                                              fused_stokes_trapezoid_iters,
+                                              stokes_trapezoid_supported)
 
         def pallas_it(P, Vx, Vy, Vz, Rho):
-            return lax.fori_loop(
-                0, n_inner,
-                lambda _, S: fused_stokes_iteration(
-                    *S, Rho, dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP,
-                    dtV=dtV, interpret=pallas_interpret),
-                (P, Vx, Vy, Vz))
+            # Built inside the closure: the cells must stay hashable
+            # scalars so recreated closures share one compiled program
+            # (`igg.parallel._fn_key`, see the NOTE above).
+            kw_it = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+            grid = igg.get_global_grid()
+            state = (P, Vx, Vy, Vz)
+            n = n_inner
+            Kf = 0
+            if trapezoid is not False and n_inner >= 3:
+                if K is not None:
+                    Kf = K if stokes_trapezoid_supported(
+                        grid, P.shape, K, n_inner - 1, P.dtype,
+                        interpret=pallas_interpret) else 0
+                else:
+                    Kf = fit_stokes_K(grid, P.shape, n_inner - 1, P.dtype,
+                                      interpret=pallas_interpret)
+            if trapezoid is True and not Kf:
+                raise igg.GridError(_TRAPEZOID_REQ)
+            if Kf:
+                # Warm-up per-iteration kernel: consumes (and replaces)
+                # the entry halos exactly like every other path — the
+                # exchange-fresh window state the chunk's validity
+                # argument requires, for ANY input.
+                state = fused_stokes_iteration(
+                    *state, Rho, **kw_it, interpret=pallas_interpret)
+                *state, done = fused_stokes_trapezoid_iters(
+                    *state, Rho, n_inner=n_inner - 1, K=Kf, **kw_it,
+                    interpret=pallas_interpret)
+                n = n_inner - 1 - done
+            if n:
+                state = lax.fori_loop(
+                    0, n,
+                    lambda _, S: fused_stokes_iteration(
+                        *S, Rho, **kw_it, interpret=pallas_interpret),
+                    tuple(state))
+            return tuple(state)
 
         return pallas_it
 

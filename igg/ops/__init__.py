@@ -16,8 +16,11 @@ from .stencil import interior_add
 from .hm3d_pallas import (fused_hm3d_step, fused_hm3d_steps,
                           hm3d_pallas_supported)
 from .stokes_pallas import fused_stokes_iteration, stokes_pallas_supported
+from .stokes_trapezoid import (fit_stokes_K, fused_stokes_trapezoid_iters,
+                               stokes_trapezoid_supported)
 
-__all__ = ["diffusion_compute", "fused_diffusion_step",
+__all__ = ["diffusion_compute", "fit_stokes_K", "fused_diffusion_step",
            "fused_diffusion_steps", "fused_hm3d_step", "fused_hm3d_steps",
-           "fused_stokes_iteration", "hm3d_pallas_supported",
-           "interior_add", "pallas_supported", "stokes_pallas_supported"]
+           "fused_stokes_iteration", "fused_stokes_trapezoid_iters",
+           "hm3d_pallas_supported", "interior_add", "pallas_supported",
+           "stokes_pallas_supported", "stokes_trapezoid_supported"]

@@ -127,6 +127,31 @@ def _dim_modes(grid, force_y_ext=None, force_z_ext=None):
     return tuple(modes)
 
 
+def _edge_flags(modes, grid):
+    """Per-device SMEM edge-flag vector shared by the chunk kernels
+    (diffusion and Stokes): two i32 flags per dim — "frozen" dims
+    statically flag both sides (one device IS both global edges, and no
+    `axis_index` is traced, so 1-device frozen grids still run under
+    plain `jax.jit`), "oext" dims flag the global-edge devices via
+    `axis_index`, periodic dims carry zeros."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..shared import AXIS_NAMES
+
+    flag_vals = []
+    for d in range(3):
+        if modes[d] == "frozen":
+            flag_vals += [1, 1]
+        elif modes[d] == "oext":
+            ai = lax.axis_index(AXIS_NAMES[d])
+            flag_vals += [(ai == 0).astype(jnp.int32),
+                          (ai == grid.dims[d] - 1).astype(jnp.int32)]
+        else:
+            flag_vals += [0, 0]
+    return jnp.stack([jnp.asarray(v, jnp.int32) for v in flag_vals])
+
+
 def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
                         force_y_ext=None, force_z_ext=None,
                         allow_open: bool = False) -> bool:
@@ -486,8 +511,6 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
         return out
     import jax.numpy as jnp
 
-    from ..shared import AXIS_NAMES
-
     y_ext, z_ext = extended[1], extended[2]
     if z_ext and S2e % 128 != 0:
         # Mosaic requires 128-aligned VMEM lane slices; right-pad the
@@ -520,18 +543,7 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
             for idx in (lo, hi):
                 fr_planes.append(jnp.squeeze(
                     lax.slice_in_dim(Text, idx, idx + 1, axis=d), d))
-        flag_vals = []
-        for d in range(3):
-            if modes[d] == "frozen":
-                flag_vals += [1, 1]
-            elif modes[d] == "oext":
-                ai = lax.axis_index(AXIS_NAMES[d])
-                flag_vals += [(ai == 0).astype(jnp.int32),
-                              (ai == grid.dims[d] - 1).astype(jnp.int32)]
-            else:
-                flag_vals += [0, 0]
-        flag_ops = [jnp.stack([jnp.asarray(v, jnp.int32)
-                               for v in flag_vals])]
+        flag_ops = [_edge_flags(modes, grid)]
 
     kern = partial(_kernel, K=K, bx=bx, nbe=nbe, nbo=nbo, off=off,
                    S0e=S0e, S1e=S1e, S2=S2e, modes=tuple(modes), frz=frz,

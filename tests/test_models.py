@@ -194,3 +194,35 @@ def test_interior_add_matches_at_add():
     np.testing.assert_array_equal(
         np.asarray(interior_add(B, e, ((1, 1), (0, 0)))),
         np.asarray(B.at[1:-1, :].add(e)))
+
+
+def test_stokes_trapezoid_dispatch_admission():
+    """The Stokes chunk-tier dispatch contract on make_iteration:
+    trapezoid='auto' admits a K on a supported grid, trapezoid=True
+    raises the requirement string where no K is admissible, and
+    trapezoid=True with use_pallas=False is contradictory (the chunk
+    tier rides the fused kernel).  Full equivalence coverage lives in
+    tests/test_stokes_trapezoid.py."""
+    from igg.models import stokes3d
+    from igg.ops import fit_stokes_K, stokes_trapezoid_supported
+
+    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1,
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    grid = igg.get_global_grid()
+    assert stokes_trapezoid_supported(grid, (16, 16, 128), 4, 4,
+                                      np.float32, interpret=True)
+    assert fit_stokes_K(grid, (16, 16, 128), 8, np.float32,
+                        interpret=True) == 4
+    with pytest.raises(igg.GridError, match="chunk tier"):
+        stokes3d.make_iteration(stokes3d.Params(), use_pallas=False,
+                                trapezoid=True)
+    # n_inner=1: no warm-up + chunk possible for any K.
+    params = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    it = stokes3d.make_iteration(params, donate=False, use_pallas=True,
+                                 pallas_interpret=True, n_inner=1,
+                                 trapezoid=True)
+    fields = stokes3d.init_fields(params, dtype=np.float32)
+    with pytest.raises(igg.GridError, match="chunk tier"):
+        it(*fields)
+    igg.finalize_global_grid()

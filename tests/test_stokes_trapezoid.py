@@ -1,0 +1,370 @@
+"""K-iteration Stokes trapezoid chunk tier: the exchange/window machinery
+on real multi-device CPU meshes (staggered shapes, periodic/open/mixed
+dims), plus a pure-value simulation of the Mosaic kernel's banded
+in-place scheme.
+
+The chunk KERNEL is manual-DMA (TPU-only; equivalence pinned on hardware
+by tests/test_mega_tpu.py::test_stokes_trapezoid_matches_per_iteration).
+What runs here is everything around it — the grouped 2K-deep slab
+ppermutes, the exchange-fresh window construction, the shrinking-validity
+argument, and the velocity-freeze open-boundary semantics — realized in
+pure XLA (`_window_iters_xla`) on 8-device CPU meshes and compared
+against K applications of `stokes3d.local_iteration`; plus the banded
+in-place + lag-row realization the kernel executes, simulated with the
+kernel's own shared `_band_update`/`_band_halo` helpers and pinned
+against the window realization.
+"""
+
+import numpy as np
+import pytest
+
+import igg
+from igg.models import stokes3d
+
+
+def _init(mesh, periods, local=(16, 16, 128)):
+    igg.init_global_grid(local[0], local[1], local[2],
+                         dimx=mesh[0], dimy=mesh[1], dimz=mesh[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2],
+                         overlapx=3, overlapy=3, overlapz=3, quiet=True)
+    return igg.get_global_grid()
+
+
+def _fresh_fields(params=None):
+    """Nontrivial overlap-CONSISTENT fields with exchange-fresh halos —
+    the chunk tier's entry contract (`fused_stokes_trapezoid_iters`
+    docstring): the buoyancy init evolved by a few reference iterations,
+    so the duplicated overlap-region rows are globally equal (per-index
+    random fields are NOT — an overlap-3 grid exchanges one plane per
+    side, so `update_halo` alone cannot synchronize the interior
+    duplicates)."""
+    params = params or stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float32)
+    it = stokes3d.make_iteration(params, donate=False, use_pallas=False,
+                                 n_inner=3)
+    P, Vx, Vy, Vz = it(P, Vx, Vy, Vz, Rho)
+    return P, Vx, Vy, Vz, Rho
+
+
+def _chunk_vs_per_iteration(mesh, periods, K=4, n_chunks=1, tol=2e-5):
+    """One-or-more K-chunks of the window realization vs K*n_chunks
+    applications of the plain sequential composition, from an
+    exchange-fresh state."""
+    from jax import lax
+
+    from igg.ops.stokes_trapezoid import (_dim_modes,
+                                          fused_stokes_trapezoid_iters,
+                                          stokes_trapezoid_supported)
+
+    grid = _init(mesh, periods)
+    kw = stokes3d._pseudo_steps(stokes3d.Params(lx=4.0, ly=4.0, lz=4.0))
+    n = K * n_chunks
+    assert stokes_trapezoid_supported(grid, (16, 16, 128), K, n,
+                                      np.float32, interpret=True)
+    fields = _fresh_fields()
+    Rho = fields[4]
+
+    @igg.sharded
+    def chunk(P, Vx, Vy, Vz, Rho):
+        out = fused_stokes_trapezoid_iters(P, Vx, Vy, Vz, Rho, n_inner=n,
+                                           K=K, **kw, interpret=True)
+        return out[:4]
+
+    @igg.sharded
+    def per_it(P, Vx, Vy, Vz, Rho):
+        return lax.fori_loop(
+            0, n, lambda _, S: stokes3d.local_iteration(*S, Rho, **kw),
+            (P, Vx, Vy, Vz))
+
+    out = chunk(*fields)
+    ref = per_it(*fields)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), ref, out):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-30)
+        assert rel < tol, (name, rel, mesh, periods)
+    modes = _dim_modes(grid)
+    igg.finalize_global_grid()
+    return modes
+
+
+def test_ring_periodic():
+    """(8,1,1) fully periodic: x extended by self/neighbor slabs, y/z
+    in-window self-wrap with per-field staggered ol."""
+    assert _chunk_vs_per_iteration((8, 1, 1), (1, 1, 1)) == (
+        "ext", "wrap", "wrap")
+
+
+def test_ring_open():
+    """(8,1,1) all open — the reference-default boundary condition:
+    'oext' x (non-wrapping slab permutes + edge-device velocity freeze),
+    frozen y/z."""
+    assert _chunk_vs_per_iteration((8, 1, 1), (0, 0, 0)) == (
+        "oext", "frozen", "frozen")
+
+
+def test_torus_222_periodic():
+    """(2,2,2) fully periodic 3-D torus: x/y/z all extended, corners via
+    the later neighbors' earlier-dim extensions, staggered z slabs
+    transpose-carried."""
+    assert _chunk_vs_per_iteration((2, 2, 2), (1, 1, 1)) == (
+        "ext", "ext", "ext")
+
+
+def test_torus_222_open():
+    """(2,2,2) all open: 'oext' on every dim — velocity shoulder freezing
+    layered under later-dim extensions."""
+    assert _chunk_vs_per_iteration((2, 2, 2), (0, 0, 0)) == (
+        "oext", "oext", "oext")
+
+
+def test_mixed_open_x_z():
+    """Mixed (2,2,2): open x and z around a periodic extended y."""
+    assert _chunk_vs_per_iteration((2, 2, 2), (0, 1, 0)) == (
+        "oext", "ext", "oext")
+
+
+def test_mesh_421_mixed_wrap():
+    """(4,2,1): z wrapped in-window, x/y extended, open y."""
+    assert _chunk_vs_per_iteration((4, 2, 1), (1, 0, 1)) == (
+        "ext", "oext", "wrap")
+
+
+def test_single_device_selfwrap_two_chunks():
+    """(1,1,1) fully periodic (the benchmark's self-wrap grid): x rides
+    self-neighbor slabs, y/z wrap; two chained chunks exercise
+    chunk-exit halo invariants feeding the next chunk's extension."""
+    assert _chunk_vs_per_iteration((1, 1, 1), (1, 1, 1),
+                                   n_chunks=2) == ("ext", "wrap", "wrap")
+
+
+def test_single_device_frozen():
+    """(1,1,1) all open: every dim 'frozen' — no extension at all, the
+    velocity boundary planes re-frozen every iteration."""
+    assert _chunk_vs_per_iteration((1, 1, 1), (0, 0, 0)) == (
+        "frozen", "frozen", "frozen")
+
+
+# ---------------------------------------------------------------------------
+# Model-path dispatch (make_iteration admission)
+# ---------------------------------------------------------------------------
+
+def _model_compare(grid_kw, n_inner, tol=2e-4, **mk_kw):
+    """Chunk tier vs the per-iteration KERNEL path (the tight check —
+    isolates exactly what the chunk tier adds), plus a coarse check
+    against the XLA composition (the per-iteration kernel itself sits at
+    ~1e-4 relative on the near-rest velocities of this state, so the
+    XLA bound is loose by design — its tight bound is
+    tests/test_stokes_pallas.py)."""
+    fields = _fresh_fields()
+    params = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    xla = stokes3d.make_iteration(params, donate=False, use_pallas=False,
+                                  n_inner=n_inner)
+    ref = stokes3d.make_iteration(params, donate=False, use_pallas=True,
+                                  pallas_interpret=True, n_inner=n_inner,
+                                  trapezoid=False)
+    pal = stokes3d.make_iteration(params, donate=False, use_pallas=True,
+                                  pallas_interpret=True, n_inner=n_inner,
+                                  **mk_kw)
+    x = xla(*fields)
+    r = ref(*fields)
+    o = pal(*fields)
+    for name, a, b, c in zip(("P", "Vx", "Vy", "Vz"), r, o, x):
+        a, b, c = (np.asarray(v, np.float64) for v in (a, b, c))
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-30)
+        assert rel < tol, (name, rel, grid_kw)
+        rel_x = np.max(np.abs(c - b)) / (np.max(np.abs(c)) + 1e-30)
+        assert rel_x < 1e-3, (name, rel_x, grid_kw)
+
+
+def test_model_path_chunks_engage_ring():
+    """make_iteration routes the (8,1,1) periodic mesh through the chunk
+    tier (warm-up + one K=4 chunk) and must match the XLA composition."""
+    from igg.ops.stokes_trapezoid import fit_stokes_K
+
+    grid = _init((8, 1, 1), (1, 1, 1))
+    assert fit_stokes_K(grid, (16, 16, 128), 4, np.float32,
+                        interpret=True) == 4
+    _model_compare({}, n_inner=5, trapezoid=True)
+    igg.finalize_global_grid()
+
+
+def test_model_path_chunks_with_remainder_open():
+    """Open (8,1,1) mesh, n_inner=7 = warm-up + one K=4 chunk + 2
+    remainder per-iteration kernels."""
+    _init((8, 1, 1), (0, 0, 0))
+    _model_compare({}, n_inner=7, trapezoid=True, K=4)
+    igg.finalize_global_grid()
+
+
+def test_model_auto_falls_back_when_unsupported():
+    """trapezoid='auto' with too few iterations silently runs the
+    per-iteration kernel (n_inner=2 < K+1 for every K)."""
+    _init((8, 1, 1), (1, 1, 1))
+    _model_compare({}, n_inner=2)
+    igg.finalize_global_grid()
+
+
+def test_model_trapezoid_true_raises_when_unsupported():
+    """trapezoid=True is a real contract: requirement-string GridError
+    when no K is admissible (here: n_inner too small for any chunk)."""
+    _init((8, 1, 1), (1, 1, 1))
+    params = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    fields = _fresh_fields()
+    it = stokes3d.make_iteration(params, donate=False, use_pallas=True,
+                                 pallas_interpret=True, n_inner=2,
+                                 trapezoid=True)
+    with pytest.raises(igg.GridError, match="chunk tier"):
+        it(*fields)
+    igg.finalize_global_grid()
+
+
+def test_model_trapezoid_true_with_xla_path_raises():
+    _init((8, 1, 1), (1, 1, 1))
+    params = stokes3d.Params()
+    with pytest.raises(igg.GridError, match="chunk tier"):
+        stokes3d.make_iteration(params, use_pallas=False, trapezoid=True)
+    igg.finalize_global_grid()
+
+
+def test_gate_rejects():
+    """Admission matrix of stokes_trapezoid_supported."""
+    from igg.ops.stokes_trapezoid import stokes_trapezoid_supported
+
+    grid = _init((8, 1, 1), (1, 1, 1))
+    s = (16, 16, 128)
+    ok = stokes_trapezoid_supported
+    assert ok(grid, s, 4, 4, np.float32)
+    assert not ok(grid, s, 4, 3, np.float32)      # no full chunk
+    assert not ok(grid, s, 1, 8, np.float32)      # K < 2
+    assert not ok(grid, s, 8, 8, np.float32)      # 2K send slabs too deep
+    assert not ok(grid, s, 4, 4, np.float64)      # f32 only
+    igg.finalize_global_grid()
+    grid = igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                                periodx=1, periody=1, periodz=1,
+                                quiet=True)  # overlap 2
+    grid = igg.get_global_grid()
+    assert not ok(grid, s, 4, 4, np.float32)
+    igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# Banded in-place simulation of the Mosaic kernel's scheme
+# ---------------------------------------------------------------------------
+
+def _banded_sim(exts, Rho_ext, K, modes, grid, scal, ols, shapes):
+    """Pure-value simulation of `stokes_trapezoid._kernel`'s execution:
+    in-place x-row bands with the one-row lag carry and clamped margins,
+    calling the kernel's own `_band_update`/`_band_halo` helpers — so the
+    band indexing the TPU kernel executes is pinned on CPU against the
+    window realization."""
+    import jax.numpy as jnp
+
+    from igg.ops.stokes_trapezoid import _BX, _band_halo, _band_update
+
+    E = 2 * K
+    bx = _BX
+    fv = [np.array(x) for x in exts] + [np.array(Rho_ext)]
+    ext_shapes = tuple(tuple(x.shape) for x in fv)
+    cfg = dict(modes=tuple(modes), ols=tuple(ols[:4]),
+               ext_shapes=ext_shapes, E=E, shapes=tuple(shapes[:4]))
+    # Single-device simulation: frozen dims statically flag both sides.
+    flags = [1 if modes[d] == "frozen" else 0 for d in range(3)
+             for _ in (0, 1)]
+    frx, fr_yz = {}, {}
+    for d in range(3):
+        if modes[d] not in ("oext", "frozen"):
+            continue
+        lo = E if modes[d] == "oext" else 0
+        for f in (1, 2, 3):
+            hi = lo + shapes[f][d] - 1
+            for side, idx in ((0, lo), (1, hi)):
+                plane = np.take(fv[f], idx, axis=d).copy()
+                if d == 0:
+                    frx[(f, side)] = jnp.asarray(plane)
+                else:
+                    fr_yz[(f, d, side)] = plane
+    S0e = ext_shapes[0][0]
+    nb = S0e // bx
+    lag = [np.zeros((2,) + ext_shapes[f][1:], fv[f].dtype)
+           for f in range(4)]
+    for k in range(K):
+        for i in range(nb):
+            a = i * bx
+            sl = i % 2
+            for f in range(4):
+                lag[f][sl] = fv[f][a + bx - 1]
+
+            def window(f, extra):
+                if f == 4:   # Rho: never overwritten, clamped direct read
+                    m1 = fv[f][max(a - 1, 0)][None]
+                else:
+                    m1 = (fv[f][0:1] if i == 0
+                          else lag[f][1 - sl][None])
+                parts = [m1, fv[f][a:a + bx]]
+                top = ext_shapes[f][0] - 1
+                for e in range(1, extra + 1):
+                    parts.append(fv[f][min(a + bx + e - 1, top)][None])
+                return jnp.asarray(np.concatenate(parts, axis=0))
+
+            news = _band_update(window(0, 1), window(1, 2), window(2, 1),
+                                window(3, 1), window(4, 1), bx=bx,
+                                scal=scal)
+            fryz = {key: jnp.asarray(p[a:a + bx])
+                    for key, p in fr_yz.items()}
+            news = _band_halo(news, a, bx, flags, frx, fryz, cfg)
+            for f in range(4):
+                fv[f][a:a + bx] = np.asarray(news[f])
+    out = []
+    for f in range(4):
+        F = fv[f]
+        for d in range(3):
+            if modes[d] in ("ext", "oext"):
+                F = np.take(F, range(E, E + shapes[f][d]), axis=d)
+        out.append(F)
+    return out
+
+
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0)],
+                         ids=["selfwrap_ext_x", "frozen"])
+def test_banded_scheme_matches_window(periods):
+    """The kernel's banded in-place + lag-row scheme (shared
+    `_band_update`/`_band_halo` helpers) must reproduce the window
+    realization's central blocks on a 1-device grid — periodic
+    (x self-extended, y/z wrap) and all-frozen."""
+    from igg.ops.stokes_trapezoid import (_dim_modes, _extend_fields,
+                                          _field_shapes, _ols,
+                                          _window_iters_xla,
+                                          stokes_trapezoid_supported)
+
+    grid = _init((1, 1, 1), periods)
+    K = 4
+    E = 2 * K
+    modes = _dim_modes(grid)
+    kw = stokes3d._pseudo_steps(stokes3d.Params(lx=4.0, ly=4.0, lz=4.0))
+    assert stokes_trapezoid_supported(grid, (16, 16, 128), K, K,
+                                      np.float32)
+    P, Vx, Vy, Vz, Rho = _fresh_fields()
+    shapes = _field_shapes((16, 16, 128))
+    ols = _ols(grid, shapes)
+    exts = _extend_fields([P, Vx, Vy, Vz], ols[:4], E, grid, modes)
+    Rho_ext = _extend_fields([Rho], [ols[4]], E, grid, modes)[0]
+
+    win = _window_iters_xla(*exts, Rho_ext, K=K, E=E, modes=modes,
+                            grid=grid, scal=kw, ols=ols, shapes=shapes)
+    win_central = []
+    for f, F in enumerate(win):
+        F = np.asarray(F)
+        for d in range(3):
+            if modes[d] in ("ext", "oext"):
+                F = np.take(F, range(E, E + shapes[f][d]), axis=d)
+        win_central.append(F)
+
+    band = _banded_sim(exts, Rho_ext, K, modes, grid, kw, ols, shapes)
+    for name, a, b in zip(("P", "Vx", "Vy", "Vz"), win_central, band):
+        # Pure f32 reassociation between band-shaped and full-window
+        # fusions; the values are identical expressions per element.
+        scale = max(np.abs(a).max(), 1e-30)
+        assert np.abs(a - b).max() <= 1e-5 * scale, name
+    igg.finalize_global_grid()
