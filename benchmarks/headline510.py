@@ -41,9 +41,7 @@ The committed artifact is a full 100k-step run.
 from __future__ import annotations
 
 import pathlib
-import queue
 import sys
-import threading
 import time
 
 import numpy as np
@@ -97,36 +95,29 @@ def main():
     if outdir:
         outdir.mkdir(parents=True, exist_ok=True)
 
-    # Background render worker: receives batches of (step, device-resident
-    # mid-z slice), fetches them (one batched ~10 MB transfer — the
-    # tunneled link is latency-bound at ~1.8 s per fetch regardless of
-    # size) and renders PNGs, all off the simulation thread.
-    # maxsize bounds the outstanding dispatch depth (~30 x 1,000-step
-    # programs): natural backpressure instead of a per-dispatch sync.
-    frames_q: "queue.Queue" = queue.Queue(maxsize=3)
-    render_errors = []
+    # Background render worker (igg.vis.BackgroundRenderer — the round-5
+    # pattern, now the library's shared in-situ helper): receives batches
+    # of (step, device-resident mid-z slice), fetches them (one batched
+    # ~10 MB transfer — the tunneled link is latency-bound at ~1.8 s per
+    # fetch regardless of size) and renders PNGs, all off the simulation
+    # thread.  maxsize bounds the outstanding dispatch depth (~30 x
+    # 1,000-step programs): natural backpressure instead of a per-dispatch
+    # sync.
+    from igg.vis import BackgroundRenderer
 
-    def render_worker():
+    def render_batch(batch):
         import jax.numpy as jnp
 
-        while True:
-            batch = frames_q.get()
-            if batch is None:
-                return
-            try:
-                ks = [k for k, _ in batch]
-                stack = np.asarray(jnp.stack([s for _, s in batch]))
-                if plt is not None and outdir:
-                    for k, sl in zip(ks, stack):
-                        plt.imshow(sl.T, origin="lower", cmap="inferno")
-                        plt.title(f"T @ step {k}")
-                        plt.savefig(outdir / f"T_{k:06d}.png", dpi=60)
-                        plt.clf()
-            except Exception as e:  # surfaced after the run
-                render_errors.append(e)
+        ks = [k for k, _ in batch]
+        stack = np.asarray(jnp.stack([s for _, s in batch]))
+        if plt is not None and outdir:
+            for k, sl in zip(ks, stack):
+                plt.imshow(sl.T, origin="lower", cmap="inferno")
+                plt.title(f"T @ step {k}")
+                plt.savefig(outdir / f"T_{k:06d}.png", dpi=60)
+                plt.clf()
 
-    worker = threading.Thread(target=render_worker, daemon=True)
-    worker.start()
+    renderer = BackgroundRenderer(render_batch, maxsize=3)
 
     t0 = time.monotonic()
     done = 0
@@ -136,13 +127,12 @@ def main():
         done += vis_every
         pending.append((done, T[:, :, T.shape[2] // 2]))
         if len(pending) >= 10:
-            frames_q.put(pending)
+            renderer.submit(pending)
             pending = []
     if pending:
-        frames_q.put(pending)
-    frames_q.put(None)
+        renderer.submit(pending)
     jax.block_until_ready(T)
-    worker.join()   # the render drain is part of the wall-clock
+    render_errors = renderer.close()   # the render drain is part of the wall-clock
     if render_errors:
         note(f"render worker errors: {render_errors[:3]}")
     # Final state export: one full-volume gather (tunnel-bound here).

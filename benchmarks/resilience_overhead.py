@@ -1,0 +1,137 @@
+"""Watchdog overhead of the resilient run loop vs the bare step loop.
+
+The overhead contract of `igg.run_resilient` (docs/resilience.md): at 128^3
+with `watch_every=50` and checkpointing disabled, the device-side NaN
+watchdog — one psum'd non-finite count per watched field per watch window,
+fetched asynchronously — must add **< 2%** over the bare per-step dispatch
+loop.
+
+Methodology.  The watchdog adds exactly two things to the bare loop:
+
+  1. the probe program, dispatched once per watch window — measured
+     DIRECTLY here (batches of 10 async dispatches with one final block,
+     min over reps: in the loop the probe runs asynchronously amid the
+     step stream, so its critical-path cost is its device compute, and
+     batch-amortized timing measures exactly that — a single synchronous
+     round-trip instead measures per-dispatch host jitter, which on the
+     1-core CI host exceeds the probe itself) and divided by the window's
+     step cost: `overhead_pct = probe_s / (watch_every *
+     bare_s_per_step)`.  This is the asserted number (`"pass"`).
+  2. per-step host bookkeeping (a flag check, a modulo, an empty-deque
+     poll) — microseconds against a multi-ms step.  Its emptiness is
+     cross-checked empirically: the row also carries the end-to-end
+     wall-clock delta of `run_resilient` vs the bare loop
+     (`wall_delta_pct`, min of interleaved reps).  On the shared
+     single-core CI host that wall delta has a +/-5-10% scheduler-noise
+     floor (cf. the weak-scaling section of benchmarks/README.md) — an
+     order of magnitude above the bounded effect, which is why the
+     assertion rides the component measurement and the wall delta is
+     informational.
+
+Emits one JSON line; the CPU run is the always-present smoke row (`ci.sh`
+asserts its presence AND `"pass": true`).  Usage:
+`python benchmarks/resilience_overhead.py [n] [nt]` (default 128 300).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from common import emit, note
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    nt = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    watch_every = 50
+
+    import jax
+
+    import igg
+    from igg.models import diffusion3d as d3
+    from igg.resilience import _make_probe
+
+    platform = jax.devices()[0].platform
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+    note(f"platform={platform} devices={grid.nprocs} local={n}^3 "
+         f"nt={nt} watch_every={watch_every}")
+
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+    step = d3.make_step(params, donate=False)
+
+    def step_fn(state):
+        return {"T": step(state["T"], state["Cp"]), "Cp": state["Cp"]}
+
+    def bare():
+        state = {"T": T0, "Cp": Cp}
+        t0 = time.monotonic()
+        for _ in range(nt):
+            state = step_fn(state)
+        jax.block_until_ready(state["T"])
+        return time.monotonic() - t0
+
+    def watched():
+        t0 = time.monotonic()
+        res = igg.run_resilient(step_fn, {"T": T0, "Cp": Cp}, nt,
+                                watch_every=watch_every,
+                                watch_fields=["T"], checkpoint_every=0,
+                                install_sigterm=False)
+        jax.block_until_ready(res.state["T"])
+        return time.monotonic() - t0
+
+    # The probe, measured directly: batches of async dispatches (block on
+    # the last), min over reps — the probe's device compute, which is what
+    # it can steal from the step stream when fetched asynchronously.
+    probe = _make_probe()
+    np.asarray(probe(T0))   # compile
+    batch = 10
+    probe_ts = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        for _ in range(batch):
+            c = probe(T0)
+        jax.block_until_ready(c)
+        probe_ts.append((time.monotonic() - t0) / batch)
+    probe_s = min(probe_ts)
+
+    bare()      # warm-up the step on both loop shapes
+    watched()
+    reps = 5
+    bares, watcheds = [], []
+    for _ in range(reps):       # interleave so drift hits both equally
+        bares.append(bare())
+        watcheds.append(watched())
+    b, w = min(bares), min(watcheds)
+    bare_s_per_step = b / nt
+
+    overhead_pct = probe_s / (watch_every * bare_s_per_step) * 100.0
+    wall_delta_pct = (w - b) / b * 100.0
+
+    emit({
+        "metric": "resilience_overhead",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "config": {"local": n, "nt": nt, "watch_every": watch_every,
+                   "devices": grid.nprocs, "dims": list(grid.dims),
+                   "platform": platform, "reps": reps},
+        "bare_s_per_step": round(bare_s_per_step, 6),
+        "watched_s_per_step": round(w / nt, 6),
+        "probe_s": round(probe_s, 6),
+        "wall_delta_pct": round(wall_delta_pct, 3),
+        "pass": bool(overhead_pct < 2.0),
+        "contract": "watchdog adds < 2% over the bare step loop "
+                    "(probe cost per watch window vs the window's step "
+                    "cost; wall_delta_pct is the noisy end-to-end "
+                    "cross-check)",
+    })
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,12 @@ def main():
     # column (error bars for the predicted weak-scaling efficiencies).
     r("cost_model_calibration.py", [] if not quick else [64, 3],
       tag="cost_model_calibration")
+    # Watchdog overhead of the resilient run loop (round 8): asserted
+    # < 2% at 128^3 with watch_every=50 — the 128^3 size is part of the
+    # contract, so quick mode only trims the step count (ci.sh greps the
+    # smoke row's "pass": true).
+    r("resilience_overhead.py", [] if not quick else [128, 100],
+      tag="resilience_overhead")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).  64^3 for
     # weak scaling = compute-dominated (see benchmarks/README.md for how to

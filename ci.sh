@@ -43,6 +43,30 @@ else
     exit 1
 fi
 
+# Round 8: the resilience tier.  The chaos suite (tests/test_resilience.py:
+# NaN watchdog detection, rollback/retry bit-exactness, checkpoint ring
+# fallback past truncated/bit-flipped generations, preemption + resume,
+# halo-corruption seam, dist-init retry) ran inside the main pytest run
+# above; here the watchdog-overhead contract row is asserted (< 2% vs the
+# bare step loop at 128^3 with watch_every=50 — the row is emitted on every
+# platform, CPU included).
+if grep -q '"metric": "resilience_overhead"' \
+        benchmarks/results_smoke/resilience_overhead.jsonl \
+        && grep -q '"pass": true' \
+        benchmarks/results_smoke/resilience_overhead.jsonl; then
+    echo "    resilience_overhead smoke row PRESENT and within the <2%"
+    echo "    contract (resilience_overhead.jsonl)"
+else
+    echo "    resilience_overhead smoke row MISSING or overhead >= 2%"
+    echo "    (benchmarks/results_smoke/resilience_overhead.jsonl)"
+    exit 1
+fi
+
+echo "=== resilient run loop end-to-end (watchdog -> rollback -> retry,"
+echo "    preemption -> checkpoint -> resume; 8-device CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/resilient_run.py
+
 # Compiled-mode TPU kernel tests (VERDICT r3 weak item 4): run
 # unconditionally — the tests' own per-test gate (the single source of
 # TPU detection) skips them cleanly on chipless hosts, and the summary

@@ -20,8 +20,11 @@ Public API (reference: the 13 exported symbols at
 
 plus TPU-native extensions: field constructors (`zeros`, `ones`, `full`),
 coordinate fields (`x_g_field`, ..., `coord_fields`), whole-step SPMD
-compilation (`sharded`, `update_halo_local`, `local_coords`), and
-`gather_interior`.
+compilation (`sharded`, `update_halo_local`, `local_coords`),
+`gather_interior`, checkpointing (`save_checkpoint`, `load_checkpoint`,
+`latest_checkpoint`, `verify_checkpoint`), and the resilient run loop
+(`run_resilient` — device-side NaN watchdog, checkpoint ring with
+rollback-and-retry, preemption handling; fault injectors in `igg.chaos`).
 """
 
 from ._compat import install as _compat_install
@@ -71,11 +74,20 @@ from .fields import (
 )
 from .overlap import hide_communication
 from .parallel import local_coords, sharded
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from .resilience import ResilienceError, RunResult, run_resilient
 from .timing import time_steps
+from . import chaos
 from . import device
 from . import profiling
+from . import resilience
 from . import tools
+from . import vis
 
 __version__ = "0.1.0"
 
@@ -92,6 +104,9 @@ __all__ = [
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
     "hide_communication", "local_coords", "sharded", "profiling",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+    "verify_checkpoint",
+    "run_resilient", "RunResult", "ResilienceError", "resilience", "chaos",
+    "vis",
     "time_steps", "__version__",
 ]

@@ -1,0 +1,215 @@
+"""Deterministic fault injection — the provability harness for
+:mod:`igg.resilience`.
+
+Failure handling that is only argued about is not robustness; every
+detection and recovery path of the resilient loop must be demonstrable in
+CI on the 8-device CPU mesh.  This module provides the four injectors the
+test matrix drives (`tests/test_resilience.py`), each deterministic and
+one-shot by default so a rolled-back replay does not re-fail:
+
+- :class:`ChaosPlan` — NaN seeded into a named field at step k, and/or a
+  simulated preemption (sets the same flag SIGTERM does) at step k;
+  consumed by ``run_resilient(..., chaos=plan)``.
+- :func:`corrupt_checkpoint` — damage a checkpoint file on disk: truncate
+  it (a crashed/preempted writer on a non-atomic filesystem), or flip one
+  payload byte while keeping the zip container self-consistent, so the
+  per-array CRC32 manifest — not the container — is what catches it.
+- :func:`halo_corruption` — corrupt the RECEIVED halo planes through a
+  test seam in :mod:`igg.halo` (`_CHAOS_PLANE_TAP`, applied at the single
+  plane-exchange primitive every wire path funnels through).  The tap is
+  traced into the compiled halo programs, so arming/disarming clears the
+  compiled-program caches; a recovery policy that calls ``disarm()``
+  models a transient link/memory fault that heals on retry.
+
+This is a test/CI surface: nothing here is imported by the library's hot
+paths, and the only production-adjacent hook is the documented
+`chaos=` parameter of :func:`igg.resilience.run_resilient`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import zipfile
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .shared import GridError
+
+__all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
+           "HaloCorruption"]
+
+
+class ChaosPlan:
+    """Deterministic in-loop fault plan for :func:`igg.run_resilient`.
+
+    `nan_at`: iterable of `(step, field)` or `(step, field, index)` — before
+    the dispatch that advances past `step`, write NaN into `state[field]` at
+    `index` (default: element `(1, 1, ...)`, an INTERIOR cell of the block
+    on device (0,0,0) — a halo cell would be healed by the next exchange
+    before any stencil reads it, which is exactly the fault that needs no
+    recovery).
+    `preempt_at`: simulate a preemption signal when the loop reaches that
+    step.  Each injection fires ONCE (a transient fault): after rollback the
+    replay passes the same step clean, which is exactly what makes
+    recovery-without-policy provable.  `reset()` re-arms everything.
+    """
+
+    def __init__(self, nan_at: Sequence = (),
+                 preempt_at: Optional[int] = None):
+        self.nan_at: Tuple = tuple(
+            (e[0], e[1],
+             tuple(e[2]) if len(e) > 2 and e[2] is not None else None)
+            for e in nan_at)
+        self.preempt_at = preempt_at
+        self._fired = set()
+
+    def reset(self) -> None:
+        self._fired.clear()
+
+    def apply(self, state: dict, step: int, emit, span: int = 1) -> dict:
+        """Called by the resilient loop before each dispatch with the
+        current state and step count; returns the (possibly corrupted)
+        state.  `span` is the loop's `steps_per_call`: an injection step
+        anywhere inside the coming dispatch window `[step, step + span)`
+        fires at this boundary (the closest a host-side injector can get
+        to "at step k" when k is inside a compiled multi-step dispatch).
+        `emit(kind, step, **detail)` logs the injection into the run's
+        event stream so tests can anchor assertions to it."""
+        for k, field, index in self.nan_at:
+            key = ("nan", k, field, index)
+            if step <= k < step + span and key not in self._fired:
+                self._fired.add(key)
+                if field not in state:
+                    raise GridError(f"ChaosPlan: field {field!r} not in "
+                                    f"state {sorted(state)}.")
+                state = dict(state)
+                state[field] = _poison(state[field], index)
+                emit("chaos_nan", step, field=field)
+        if (self.preempt_at is not None
+                and step <= self.preempt_at < step + span
+                and ("preempt", self.preempt_at) not in self._fired):
+            self._fired.add(("preempt", self.preempt_at))
+            emit("chaos_preempt", step)
+            from .resilience import request_preemption
+
+            request_preemption()
+        return state
+
+
+def _poison(A, index=None):
+    """NaN written into one element of a (sharded) grid array, sharding
+    preserved."""
+    import jax
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(A.dtype, jnp.inexact):
+        raise GridError(f"ChaosPlan: cannot seed NaN into dtype {A.dtype}.")
+    idx = (tuple(index) if index is not None
+           else tuple(min(1, s - 1) for s in A.shape))
+    out = A.at[idx].set(jnp.asarray(float("nan"), A.dtype))
+    sharding = getattr(A, "sharding", None)
+    return jax.device_put(out, sharding) if sharding is not None else out
+
+
+def corrupt_checkpoint(path, mode: str = "truncate", *,
+                       field: Optional[str] = None, seed: int = 0) -> None:
+    """Deterministically damage a checkpoint file in place.
+
+    `mode="truncate"`: cut the file to half its bytes — the shape a
+    crashed or preempted writer leaves on a non-atomic filesystem (the zip
+    central directory is gone; `np.load` fails structurally).
+    `mode="bitflip"`: XOR one byte inside one array's payload and REWRITE
+    the zip container consistently (entry sizes and container CRCs match
+    the new bytes) — only the `__igg_meta__` CRC32 manifest can catch it,
+    which is the layer under test.  `field` picks the member (default: the
+    first non-meta array, sorted); `seed` picks the byte.
+    """
+    path = pathlib.Path(path)
+    if mode == "truncate":
+        data = path.read_bytes()
+        path.write_bytes(data[:max(1, len(data) // 2)])
+        return
+    if mode != "bitflip":
+        raise GridError(f"corrupt_checkpoint: unknown mode {mode!r} "
+                        f"(expected 'truncate' or 'bitflip').")
+    with zipfile.ZipFile(path) as zf:
+        entries = {n: zf.read(n) for n in zf.namelist()}
+    victims = sorted(n for n in entries if n != "__igg_meta__.npy")
+    name = (f"{field}.npy" if field is not None else victims[0])
+    if name not in entries:
+        raise GridError(f"corrupt_checkpoint: no member {name!r} in {path} "
+                        f"(has {sorted(entries)}).")
+    buf = bytearray(entries[name])
+    # Flip a byte in the DATA portion, past the ~128-byte npy header, so the
+    # npy descriptor still parses and only the array bytes disagree.
+    lo = min(128, len(buf) - 1)
+    span = max(1, len(buf) - lo)
+    pos = min(len(buf) - 1,
+              lo + int(np.random.default_rng(seed).integers(0, span)))
+    buf[pos] ^= 0x01
+    entries[name] = bytes(buf)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        for n, data in entries.items():
+            zf.writestr(n, data)
+
+
+class HaloCorruption:
+    """Armed halo-plane corruption (see :func:`halo_corruption`)."""
+
+    def __init__(self, value: float = float("nan")):
+        self._value = value
+
+    def _tap(self, d, first, last):
+        import jax.numpy as jnp
+
+        def hit(P):
+            # jnp.issubdtype, not a numpy kind test: extension floats
+            # (bfloat16, float8_*) are numpy kind 'V' and a "fc" check
+            # would silently never corrupt their planes.
+            if P is None or not jnp.issubdtype(P.dtype, jnp.inexact):
+                return P
+            return jnp.full_like(P, self._value)
+
+        return hit(first), hit(last)
+
+    def arm(self) -> "HaloCorruption":
+        _install_tap(self._tap)
+        return self
+
+    def disarm(self) -> None:
+        _install_tap(None)
+
+    def __enter__(self) -> "HaloCorruption":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def halo_corruption(value: float = float("nan")) -> HaloCorruption:
+    """Context manager corrupting every RECEIVED halo plane with `value`
+    (default NaN) through the `igg.halo._CHAOS_PLANE_TAP` seam — the
+    deterministic stand-in for a corrupted interconnect transfer.  Arming
+    and disarming clear the compiled halo/sharded program caches (the tap
+    is traced into the programs); `disarm()` from a recovery policy models
+    a transient fault that heals on retry::
+
+        fault = igg.chaos.halo_corruption()
+        with fault:
+            result = igg.run_resilient(
+                step, state, n,
+                recovery_policy=lambda k, s, ev: (fault.disarm(), None)[1],
+                ...)
+    """
+    return HaloCorruption(value)
+
+
+def _install_tap(tap) -> None:
+    from . import halo, parallel
+
+    halo._CHAOS_PLANE_TAP = tap
+    # The tap is read at trace time: drop every compiled program that may
+    # have baked in the previous tap state.
+    halo.free_update_halo_buffers()
+    parallel.free_sharded_cache()

@@ -10,7 +10,13 @@ boundary values, and must survive a resume bit-for-bit) plus the grid
 geometry, and restore into an identically-decomposed grid.
 
 Format: one `numpy` `.npz` per checkpoint with a `__igg_meta__` JSON entry
-recording `(nxyz, dims, overlaps, periods, nprocs)`.  Restore validates
+recording `(nxyz, dims, overlaps, periods, nprocs)` plus a per-array CRC32
+manifest (`crc32`, round 8) computed over each array's stored bytes and
+verified on load — a truncated or bit-flipped checkpoint raises `GridError`
+naming the path instead of surfacing a raw `zipfile.BadZipFile`, and
+:func:`latest_checkpoint` scans a directory's generation files newest-first
+skipping anything that fails verification (the rollback contract of
+:mod:`igg.resilience`).  Restore validates
 the geometry against the live grid and fails loudly on any mismatch — a
 checkpoint is tied to its decomposition because the stacked array's shape
 is `dims * local` and halo cells are decomposition-dependent.  To move a
@@ -29,20 +35,36 @@ from __future__ import annotations
 
 import json
 import pathlib
-from typing import Dict
+import re
+import zlib
+from typing import Dict, Optional
 
 import numpy as np
 
 from . import shared
 from .shared import GridError
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "verify_checkpoint", "checkpoint_step", "list_generations"]
 
 _META_KEY = "__igg_meta__"
 
 # One-time memory-cliff warning flag (multi-controller checkpoint
 # materializes every field's global array on every process).
 _warned_ckpt_cliff = False
+
+# One-time warning flag for sweeping stale `*.tmp` files a crashed run left
+# behind mid-`_write_npz`.
+_warned_stale_tmp = False
+
+
+def _crc32(arr: np.ndarray) -> int:
+    """CRC32 of an array's raw bytes (C-order).  Cheap relative to the
+    device→host fetch the arrays already paid, and independent of the zip
+    container's own entry checksums — it lives in the `__igg_meta__`
+    manifest, so a rewritten-but-wrong payload is still caught."""
+    a = np.ascontiguousarray(arr)
+    return int(zlib.crc32(a.reshape(-1).view(np.uint8)))
 
 
 def _meta(grid) -> dict:
@@ -53,6 +75,53 @@ def _meta(grid) -> dict:
         "periods": list(grid.periods),
         "nprocs": grid.nprocs,
     }
+
+
+# A .tmp file younger than this is assumed to belong to a LIVE concurrent
+# writer (another process checkpointing into the same directory) and is
+# left alone; a crashed writer's file only accrues age.
+_STALE_TMP_AGE_S = 300.0
+
+
+def _sweep_stale_tmp(parent: pathlib.Path) -> None:
+    """Remove old `*.npz.tmp` files left in the checkpoint directory by a
+    crash mid-`_write_npz` (the atomic-rename pattern never publishes them,
+    so any that exist are garbage from a dead writer).  Two guards keep the
+    sweep from touching files it does not own: only the `*.npz.tmp` shape
+    `_write_npz` stages (a suffix-less checkpoint path leaves a `*.tmp`
+    unswept — rare and harmless — rather than risk deleting another tool's
+    temp file from a shared directory), and only files older than
+    `_STALE_TMP_AGE_S` — a young one may be a live concurrent writer
+    mid-write, and unlinking it would make its `os.replace` fail.  Warns
+    once per process."""
+    import time
+
+    global _warned_stale_tmp
+
+    now = time.time()
+    stale = []
+    for p in sorted(parent.glob("*.npz.tmp")):
+        try:
+            if now - p.stat().st_mtime >= _STALE_TMP_AGE_S:
+                stale.append(p)
+        except OSError:
+            pass   # vanished under us (its writer finished or swept it)
+    if not stale:
+        return
+    if not _warned_stale_tmp:
+        import warnings
+
+        _warned_stale_tmp = True
+        warnings.warn(
+            f"igg.save_checkpoint: sweeping {len(stale)} stale .tmp file(s) "
+            f"left by a crashed writer in {parent} (e.g. {stale[0].name}); "
+            f"checkpoints publish atomically, so .tmp files are never valid "
+            f"state.  (Warned once per process.)", stacklevel=3)
+    for p in stale:
+        try:
+            p.unlink()
+        except OSError:
+            pass  # another process swept it first
 
 
 def _write_npz(path, arrays: Dict[str, np.ndarray]) -> None:
@@ -124,7 +193,9 @@ def save_checkpoint(path, /, **fields) -> None:
     if jax.process_index() == 0:
         path = pathlib.Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        meta = {**_meta(grid), "dtypes": dtypes}
+        _sweep_stale_tmp(path.parent)
+        meta = {**_meta(grid), "dtypes": dtypes,
+                "crc32": {name: _crc32(arr) for name, arr in host.items()}}
         _write_npz(path, {**host, _META_KEY: np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8)})
     if jax.process_count() > 1:
@@ -157,9 +228,7 @@ def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
 
     shared.check_initialized()
     grid = shared.global_grid()
-    with np.load(pathlib.Path(path)) as z:
-        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
-        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    meta, arrays = _read_verified(pathlib.Path(path))
 
     mine = _meta(grid)
     same_geometry = {k: meta.get(k) for k in mine} == mine
@@ -179,13 +248,138 @@ def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
     dtypes = meta.get("dtypes", {})
     out = {}
     for name, arr in arrays.items():
-        want = np.dtype(dtypes.get(name, str(arr.dtype)))
-        if arr.dtype != want:
-            arr = arr.view(want)   # extension dtypes stored as raw bytes
+        try:
+            want = np.dtype(dtypes.get(name, str(arr.dtype)))
+            if arr.dtype != want:
+                arr = arr.view(want)   # extension dtypes stored as raw bytes
+        except (TypeError, ValueError) as e:
+            raise GridError(
+                f"load_checkpoint: corrupt dtypes manifest for field "
+                f"{name!r} in {path} ({e}).") from e
         if not same_geometry:
             arr = _redistribute(name, arr, meta, grid)
         out[name] = jax.device_put(arr, sharding_for(arr.ndim))
     return out
+
+
+def _read_verified(path: pathlib.Path):
+    """Read every entry of a checkpoint file and verify the per-array CRC32
+    manifest.  Returns `(meta, arrays)`; raises `GridError` naming the path
+    for anything unreadable — a missing file, a zip truncated by a crashed
+    or preempted writer, a payload whose container checksum fails, or an
+    array whose manifest CRC32 disagrees with its bytes."""
+    import zipfile
+
+    try:
+        with np.load(path) as z:
+            if _META_KEY not in z.files:
+                raise GridError(
+                    f"load_checkpoint: {path} has no {_META_KEY!r} entry — "
+                    f"not an igg checkpoint (or one truncated before the "
+                    f"manifest was written).")
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    except GridError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError, KeyError,
+            json.JSONDecodeError) as e:
+        raise GridError(
+            f"load_checkpoint: cannot read checkpoint {path}: "
+            f"{type(e).__name__}: {e} — the file is missing, truncated, or "
+            f"corrupt (a crash mid-write never publishes a partial file; "
+            f"this one was damaged after the fact or never completed on a "
+            f"non-atomic filesystem).") from e
+
+    crcs = meta.get("crc32", {})   # absent in pre-round-8 checkpoints
+    for name, arr in arrays.items():
+        want = crcs.get(name)
+        if want is not None and _crc32(arr) != want:
+            raise GridError(
+                f"load_checkpoint: CRC32 mismatch for field {name!r} in "
+                f"{path} ({_crc32(arr):#010x} != recorded {want:#010x}) — "
+                f"the checkpoint is corrupt.")
+    return meta, arrays
+
+
+def verify_checkpoint(path, *, check_finite: bool = False) -> bool:
+    """Whether `path` is a readable, checksum-consistent checkpoint.
+
+    Reads every array and verifies the CRC32 manifest (files written before
+    the manifest existed verify structurally only).  With
+    `check_finite=True`, additionally require every floating/complex field
+    to be entirely finite — the health gate :mod:`igg.resilience` applies
+    when choosing a rollback generation, since a checkpoint written between
+    a NaN blowup and its detection is structurally perfect but poisoned.
+    Purely host-side (no grid needs to be initialized)."""
+    try:
+        meta, arrays = _read_verified(pathlib.Path(path))
+    except GridError:
+        return False
+    if not check_finite:
+        return True
+    dtypes = meta.get("dtypes", {})
+    for name, arr in arrays.items():
+        # A malformed dtypes manifest entry (version-skewed writer, damaged
+        # meta — the CRC32 manifest covers arrays, not itself) must read as
+        # "not a valid checkpoint", never escape as a raw TypeError/
+        # ValueError and kill the skip-corrupt fallback in the callers.
+        try:
+            want = np.dtype(dtypes.get(name, str(arr.dtype)))
+            if arr.dtype != want:
+                arr = arr.view(want)   # extension dtypes stored as raw bytes
+        except (TypeError, ValueError):
+            return False
+        if want.kind in "biu":
+            continue               # integral: always finite
+        # f/c AND the kind-'V' extension floats (bfloat16, float8_* — a
+        # kind check of "fc" would wave a NaN-poisoned bf16 field through
+        # the health gate); np.isfinite handles them via ml_dtypes.
+        try:
+            ok = bool(np.isfinite(arr).all())
+        except TypeError:          # dtype without isfinite support
+            continue
+        if not ok:
+            return False
+    return True
+
+
+def checkpoint_step(path) -> Optional[int]:
+    """Step number encoded in a generation filename (`<prefix>_<step>.npz`,
+    the ring layout :mod:`igg.resilience` writes); None for non-generation
+    names."""
+    m = re.search(r"_(\d+)\.npz$", pathlib.Path(path).name)
+    return int(m.group(1)) if m else None
+
+
+def list_generations(directory, prefix: str = "ckpt"):
+    """All generation files `{prefix}_<digits>.npz` in `directory` as a
+    `[(step, path), ...]` list sorted by step (strict filename match — a
+    sibling ring under a longer prefix like 'ckpt_b' never matches).  The
+    single scan shared by :func:`latest_checkpoint` and the resilience
+    ring's pruning/rollback, so the two can never disagree on what a
+    generation is."""
+    directory = pathlib.Path(directory)
+    gens = []
+    for p in directory.glob(f"{prefix}_*.npz"):
+        if re.fullmatch(re.escape(prefix) + r"_\d+\.npz", p.name):
+            gens.append((checkpoint_step(p), p))
+    return sorted(gens)
+
+
+def latest_checkpoint(directory, prefix: str = "ckpt", *,
+                      check_finite: bool = False) -> Optional[pathlib.Path]:
+    """Newest valid checkpoint generation in `directory`.
+
+    Scans `{prefix}_<step>.npz` files newest-first (by the step encoded in
+    the filename) and returns the first that passes
+    :func:`verify_checkpoint` — a truncated or corrupt newest generation is
+    skipped, falling back to the previous one.  Returns None when no valid
+    generation exists.  `check_finite` additionally skips generations
+    holding non-finite field values (resume-after-blowup safety)."""
+    for _, p in reversed(list_generations(directory, prefix)):
+        if verify_checkpoint(p, check_finite=check_finite):
+            return p
+    return None
 
 
 def _redistribute(name: str, arr: np.ndarray, meta: dict, grid) -> np.ndarray:

@@ -110,6 +110,21 @@ _FORCE_WRITER_INTERPRET = False
 # the CPU suite pins its plane wiring/corner propagation for equivalence.
 _FORCE_STACKED64 = False
 
+# Fault-injection seam (igg.chaos.halo_corruption): a callable
+# `(d, first, last) -> (first, last)` applied to the planes
+# `exchange_planes` returns — the single primitive every wire path (grouped,
+# sequential, stacked-64) funnels through — so received-halo corruption is
+# injectable deterministically for the resilience test matrix.  Read at
+# TRACE time: installers must clear the compiled caches (igg.chaos does).
+_CHAOS_PLANE_TAP = None
+
+
+def _chaos_tap(d: int, first, last):
+    tap = _CHAOS_PLANE_TAP
+    if tap is None:
+        return first, last
+    return tap(d, first, last)
+
 
 def free_update_halo_buffers() -> None:
     """Drop all compiled halo programs (reference
@@ -256,11 +271,11 @@ def exchange_planes(left_send, right_send, stale_first, stale_last,
     if periodic and disp % n == 0:
         # Every rank is its own partner (n == 1, or disp wrapping onto
         # itself): a pure local copy, no collective.
-        return right_send, left_send
+        return _chaos_tap(d, right_send, left_send)
     if not periodic and disp >= n:
         # No rank has a partner `disp` steps away inside an open axis
         # (includes the open n == 1 case).
-        return stale_first, stale_last
+        return _chaos_tap(d, stale_first, stale_last)
 
     shift_down = ([(i, i - disp) for i in range(disp, n)]
                   + ([(i, (i - disp) % n) for i in range(min(disp, n))]
@@ -271,10 +286,11 @@ def exchange_planes(left_send, right_send, stale_first, stale_last,
     from_right = lax.ppermute(left_send, axis, shift_down)   # right nb's inner plane
     from_left = lax.ppermute(right_send, axis, shift_up)     # left nb's inner plane
     if periodic:
-        return from_left, from_right
+        return _chaos_tap(d, from_left, from_right)
     idx = lax.axis_index(axis)
-    return (jnp.where(idx >= disp, from_left, stale_first),
-            jnp.where(idx < n - disp, from_right, stale_last))
+    return _chaos_tap(d,
+                      jnp.where(idx >= disp, from_left, stale_first),
+                      jnp.where(idx < n - disp, from_right, stale_last))
 
 
 def _wire_exchange(members, sends, stales, d: int, n: int, periodic: bool,

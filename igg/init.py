@@ -19,6 +19,57 @@ from .shared import GlobalGrid, GridError, NDIMS
 from .topology import create_mesh, dims_create
 
 
+def _init_distributed_with_retry() -> int:
+    """`jax.distributed.initialize()` with exponential backoff and a
+    deadline — the coordinator process being slower to bind its port than
+    the workers are to dial it is the standard multi-host launch flake, and
+    a worker that gives up on the first refused connection kills the whole
+    pod job.
+
+    Knobs (environment): `IGG_DIST_INIT_TIMEOUT` — total seconds to keep
+    retrying (default 300); `IGG_DIST_INIT_BACKOFF` — initial sleep between
+    attempts (default 1s, doubling to a 30s cap).  On exhaustion raises
+    `GridError` naming the coordinator address (from
+    `JAX_COORDINATOR_ADDRESS`/`COORDINATOR_ADDRESS` when set) and the last
+    underlying error.  Returns the number of attempts used (>= 1)."""
+    import os
+    import time
+
+    import jax
+
+    timeout = float(os.environ.get("IGG_DIST_INIT_TIMEOUT", "300"))
+    delay = float(os.environ.get("IGG_DIST_INIT_BACKOFF", "1"))
+    deadline = time.monotonic() + timeout
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            jax.distributed.initialize()
+            return attempt
+        # Only connectivity-shaped errors are retried (the runtime surfaces
+        # them as RuntimeError/XlaRuntimeError or OS-level socket errors);
+        # a ValueError/TypeError from bad configuration can never succeed
+        # on retry and propagates immediately.
+        except (RuntimeError, ConnectionError, OSError, TimeoutError) as e:
+            if "already initialized" in str(e).lower():
+                # A second initialize can never succeed on retry; hiding
+                # this one-line cause behind 300s of backoff and a
+                # coordinator-unreachable diagnosis would be misleading.
+                raise
+            now = time.monotonic()
+            if now >= deadline:
+                coord = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+                         or os.environ.get("COORDINATOR_ADDRESS")
+                         or "<auto-detected>")
+                raise GridError(
+                    f"jax.distributed.initialize() failed {attempt} time(s) "
+                    f"over {timeout:g}s (IGG_DIST_INIT_TIMEOUT): coordinator "
+                    f"{coord} never became reachable.  Last error: "
+                    f"{type(e).__name__}: {e}") from e
+            time.sleep(max(0.0, min(delay, deadline - now)))
+            delay = min(delay * 2, 30.0)
+
+
 def init_global_grid(nx: int, ny: int, nz: int, *,
                      dimx: int = 0, dimy: int = 0, dimz: int = 0,
                      periodx: int = 0, periody: int = 0, periodz: int = 0,
@@ -94,7 +145,9 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
                         "(neighbor displacement of the Cartesian shift).")
 
     if init_distributed:
-        jax.distributed.initialize()
+        # Retry-with-backoff: coordinator-not-yet-up is the standard
+        # multi-host launch flake (IGG_DIST_INIT_TIMEOUT/_BACKOFF knobs).
+        _init_distributed_with_retry()
 
     if devices is None:
         devices = jax.devices()

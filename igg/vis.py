@@ -1,0 +1,84 @@
+"""Background render/IO worker for in-situ visualization.
+
+In-situ visualization must not stall the simulation: the reference's
+examples gather and render synchronously on the solver thread, which at the
+headline cadence (a frame every 1,000 steps) serializes host-side
+matplotlib/transfer seconds into the wall-clock.  The pattern proven in
+`benchmarks/headline510.py` (round 5) is extracted here so examples share
+it: frames are CAPTURED on device at simulation time (a lazy device-resident
+slice — no transfer), handed to a worker thread in batches, and the worker
+does the device→host fetch plus rendering while the solver dispatches the
+next window.  The bounded queue gives natural backpressure — the solver
+blocks only once `maxsize` batches are outstanding, which also bounds the
+device dispatch depth.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List
+
+__all__ = ["BackgroundRenderer"]
+
+
+class BackgroundRenderer:
+    """Run `consume(batch)` for each submitted batch on a worker thread.
+
+    `consume` receives whatever :meth:`submit` was given (typically a list
+    of `(step, device-resident slice)` pairs) and performs the fetch +
+    render there; exceptions are collected on :attr:`errors` and surfaced
+    by :meth:`close` instead of killing the run mid-flight.  `maxsize`
+    bounds the outstanding batches (submit blocks beyond it —
+    backpressure).  Use as a context manager or call :meth:`close`, which
+    drains the queue, joins the worker, and returns the error list; the
+    drain is intentionally part of the caller's wall-clock.
+    """
+
+    def __init__(self, consume: Callable, *, maxsize: int = 3,
+                 name: str = "igg-render"):
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._errors: List[BaseException] = []
+        self._closed = False
+
+        def loop():
+            while True:
+                batch = self._q.get()
+                if batch is None:
+                    return
+                try:
+                    consume(batch)
+                except BaseException as e:   # surfaced at close()
+                    self._errors.append(e)
+
+        self._t = threading.Thread(target=loop, daemon=True, name=name)
+        self._t.start()
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return list(self._errors)
+
+    def submit(self, batch) -> None:
+        """Queue one batch for the worker (blocks when `maxsize` batches
+        are outstanding).  `None` is reserved as the shutdown sentinel."""
+        if batch is None:
+            raise ValueError("BackgroundRenderer.submit: None is the "
+                             "shutdown sentinel; submit a non-None batch.")
+        if self._closed:
+            raise RuntimeError("BackgroundRenderer is closed.")
+        self._q.put(batch)
+
+    def close(self) -> List[BaseException]:
+        """Drain remaining batches, stop the worker, and return any errors
+        it collected."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._t.join()
+        return self.errors
+
+    def __enter__(self) -> "BackgroundRenderer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
