@@ -28,8 +28,25 @@ Methodology.  The watchdog adds exactly two things to the bare loop:
      assertion rides the component measurement and the wall delta is
      informational.
 
-Emits one JSON line; the CPU run is the always-present smoke row (`ci.sh`
-asserts its presence AND `"pass": true`).  Usage:
+A second row measures the **checkpoint stall** (round 9): what the hot
+loop pays per ring generation.
+
+  - sync: one full sharded-generation write
+    (`igg.save_checkpoint_sharded` — device→host fetch of every local
+    block, CRC, zip write, manifest commit), timed directly.
+  - async: the exact submit path `run_resilient(async_checkpoint=True)`
+    runs on the hot loop — a reference snapshot of the state dict plus a
+    bounded-queue put into the background writer
+    (`igg.resilience._AsyncCheckpointWriter.submit`, measured with a free
+    queue slot; the device→host fetch and the filesystem write happen on
+    the writer thread).
+
+Contract (asserted, `"pass"` on the `checkpoint_stall` row): the async
+stall is **< 10%** of the sync write time per generation at the 128^3
+smoke size.
+
+Emits two JSON lines; the CPU run is the always-present smoke row (`ci.sh`
+asserts presence AND `"pass": true` of both).  Usage:
 `python benchmarks/resilience_overhead.py [n] [nt]` (default 128 300).
 """
 
@@ -130,6 +147,60 @@ def main():
                     "cost; wall_delta_pct is the noisy end-to-end "
                     "cross-check)",
     })
+
+    # ---- checkpoint stall: async submit vs sync sharded write ----
+    import pathlib
+    import shutil
+    import tempfile
+
+    from igg.resilience import _AsyncCheckpointWriter
+
+    ckdir = pathlib.Path(tempfile.mkdtemp(prefix="igg_ckpt_stall_"))
+    try:
+        state = {"T": T0, "Cp": Cp}
+        jax.block_until_ready(state["T"])
+
+        sync_ts = []
+        for i in range(3):
+            t0 = time.monotonic()
+            igg.save_checkpoint_sharded(ckdir / f"sync_{i}", **state)
+            sync_ts.append(time.monotonic() - t0)
+        sync_s = min(sync_ts)
+
+        # The production submit path, with a free queue slot each time
+        # (maxsize > n_gens): what run_resilient's hot loop actually pays.
+        n_gens = 4
+        writer = _AsyncCheckpointWriter(
+            lambda step, fields, lg: igg.save_checkpoint_sharded(
+                ckdir / f"async_{step}", **fields) or ckdir / f"async_{step}",
+            maxsize=n_gens + 1)
+        submit_ts = []
+        for g in range(n_gens):
+            t0 = time.monotonic()
+            writer.submit(g, state, 0)
+            submit_ts.append(time.monotonic() - t0)
+        done, errs = writer.drain()
+        writer.close()
+        assert len(done) == n_gens and not errs, (len(done), errs)
+        stall_s = sum(submit_ts) / len(submit_ts)
+
+        stall_pct = stall_s / sync_s * 100.0
+        emit({
+            "metric": "checkpoint_stall",
+            "value": round(stall_pct, 4),
+            "unit": "%",
+            "config": {"local": n, "devices": grid.nprocs,
+                       "dims": list(grid.dims), "platform": platform,
+                       "fields": ["T", "Cp"], "n_gens": n_gens},
+            "sync_write_s": round(sync_s, 6),
+            "async_submit_s": round(stall_s, 8),
+            "pass": bool(stall_pct < 10.0),
+            "contract": "hot-loop stall per generation with the background "
+                        "writer (reference snapshot + queue put) is < 10% "
+                        "of the sync sharded-generation write time",
+        })
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
     igg.finalize_global_grid()
 
 

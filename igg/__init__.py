@@ -78,7 +78,9 @@ from .checkpoint import (
     latest_checkpoint,
     load_checkpoint,
     save_checkpoint,
+    save_checkpoint_sharded,
     verify_checkpoint,
+    verify_checkpoint_distributed,
 )
 from .resilience import ResilienceError, RunResult, run_resilient
 from .timing import time_steps
@@ -104,8 +106,8 @@ __all__ = [
     "zeros", "ones", "full", "from_local_blocks", "local_blocks",
     "local_block", "spec_for", "sharding_for", "stacked_shape",
     "hide_communication", "local_coords", "sharded", "profiling",
-    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
-    "verify_checkpoint",
+    "save_checkpoint", "save_checkpoint_sharded", "load_checkpoint",
+    "latest_checkpoint", "verify_checkpoint", "verify_checkpoint_distributed",
     "run_resilient", "RunResult", "ResilienceError", "resilience", "chaos",
     "vis",
     "time_steps", "__version__",

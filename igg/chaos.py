@@ -10,10 +10,19 @@ one-shot by default so a rolled-back replay does not re-fail:
 - :class:`ChaosPlan` — NaN seeded into a named field at step k, and/or a
   simulated preemption (sets the same flag SIGTERM does) at step k;
   consumed by ``run_resilient(..., chaos=plan)``.
-- :func:`corrupt_checkpoint` — damage a checkpoint file on disk: truncate
-  it (a crashed/preempted writer on a non-atomic filesystem), or flip one
-  payload byte while keeping the zip container self-consistent, so the
-  per-array CRC32 manifest — not the container — is what catches it.
+- :func:`corrupt_checkpoint` — damage a checkpoint on disk.  On a flat
+  `.npz` file: truncate it (a crashed/preempted writer on a non-atomic
+  filesystem), or flip one payload byte while keeping the zip container
+  self-consistent, so the per-array CRC32 manifest — not the container —
+  is what catches it.  On a sharded generation DIRECTORY the same two
+  modes hit one `shard_<p>.npz` (a corrupt shard), and three more model
+  the distributed failure shapes: `missing_shard` (a host's write was
+  lost), `partial_commit` (the manifest — the commit record written last —
+  is absent: a writer that died between the shard writes and the seal),
+  and `preempt_mid_write` (the generation is still under its `.tmp`
+  staging name with no manifest: a writer preempted before the atomic
+  commit rename).  Every one must make
+  `verify_checkpoint`/`latest_checkpoint` skip the generation.
 - :func:`halo_corruption` — corrupt the RECEIVED halo planes through a
   test seam in :mod:`igg.halo` (`_CHAOS_PLANE_TAP`, applied at the single
   plane-exchange primitive every wire path funnels through).  The tap is
@@ -113,8 +122,10 @@ def _poison(A, index=None):
 
 
 def corrupt_checkpoint(path, mode: str = "truncate", *,
-                       field: Optional[str] = None, seed: int = 0) -> None:
-    """Deterministically damage a checkpoint file in place.
+                       field: Optional[str] = None, seed: int = 0,
+                       shard: int = 0) -> None:
+    """Deterministically damage a checkpoint in place — a flat `.npz` file
+    or a sharded generation directory (auto-detected).
 
     `mode="truncate"`: cut the file to half its bytes — the shape a
     crashed or preempted writer leaves on a non-atomic filesystem (the zip
@@ -124,8 +135,25 @@ def corrupt_checkpoint(path, mode: str = "truncate", *,
     the new bytes) — only the `__igg_meta__` CRC32 manifest can catch it,
     which is the layer under test.  `field` picks the member (default: the
     first non-meta array, sorted); `seed` picks the byte.
-    """
+
+    On a sharded generation directory, `truncate`/`bitflip` hit
+    `shard_<shard>.npz` (default shard 0), and three directory-only modes
+    model the distributed failure shapes (module docstring):
+    `mode="missing_shard"` deletes `shard_<shard>.npz`;
+    `mode="partial_commit"` deletes the manifest (the commit record),
+    leaving an uncommitted generation; `mode="preempt_mid_write"` rewinds
+    the generation to the instant before the atomic commit — manifest
+    removed AND the directory renamed back to its `.tmp` staging name, so
+    it is not even a generation anymore (only the stale-staging sweep will
+    ever touch it)."""
     path = pathlib.Path(path)
+    if path.is_dir():
+        return _corrupt_sharded(path, mode, field=field, seed=seed,
+                                shard=shard)
+    if mode in ("missing_shard", "partial_commit", "preempt_mid_write"):
+        raise GridError(f"corrupt_checkpoint: mode {mode!r} applies to "
+                        f"sharded generation directories; {path} is a flat "
+                        f"file.")
     if mode == "truncate":
         data = path.read_bytes()
         path.write_bytes(data[:max(1, len(data) // 2)])
@@ -152,6 +180,32 @@ def corrupt_checkpoint(path, mode: str = "truncate", *,
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
         for n, data in entries.items():
             zf.writestr(n, data)
+
+
+def _corrupt_sharded(path: pathlib.Path, mode: str, *, field, seed,
+                     shard: int) -> None:
+    """Directory branch of :func:`corrupt_checkpoint` (see there)."""
+    from .checkpoint import _MANIFEST, _shard_name
+
+    if mode == "partial_commit":
+        (path / _MANIFEST).unlink()
+        return
+    if mode == "preempt_mid_write":
+        (path / _MANIFEST).unlink()
+        path.rename(path.with_name(path.name + ".tmp"))
+        return
+    sp = path / _shard_name(shard)
+    if not sp.exists():
+        raise GridError(f"corrupt_checkpoint: generation {path} has no "
+                        f"{sp.name}.")
+    if mode == "missing_shard":
+        sp.unlink()
+        return
+    if mode not in ("truncate", "bitflip"):
+        raise GridError(f"corrupt_checkpoint: unknown mode {mode!r} "
+                        f"(expected 'truncate', 'bitflip', 'missing_shard', "
+                        f"'partial_commit', or 'preempt_mid_write').")
+    corrupt_checkpoint(sp, mode, field=field, seed=seed)
 
 
 class HaloCorruption:

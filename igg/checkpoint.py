@@ -25,36 +25,109 @@ run to a DIFFERENT decomposition, pass `redistribute=True` to
 re-tiled onto the current grid, and every block's halo cells are
 reconstructed bit-exactly by global indexing (periodic wrap included).
 
-Multi-controller runs: every process computes the full global array (the
-same `process_allgather` path `gather` uses); only process 0 writes.  On
-restore every process reads the file (shared filesystem, the standard pod
-setup) and `device_put`s its own shards.
+Two on-disk formats coexist:
+
+- **Flat `.npz`** (:func:`save_checkpoint`): one file holding every field's
+  full block-stacked global array.  Simple and portable, but the write
+  requires the global array assembled on the root process — the legacy
+  format for single-host runs and small grids.
+- **Sharded generation directory** (:func:`save_checkpoint_sharded`): the
+  production-scale format.  A checkpoint is a directory where every grid
+  block lands in its own `shard_<rank>.npz` (halo cells included — on open
+  boundaries they are user-owned data and must survive a resume
+  bit-for-bit), written by the controller process that addresses that
+  block, plus a process-0 `manifest.json` carrying the grid geometry,
+  per-field dtypes/local shapes, and a per-shard CRC32 summary.  The
+  directory is staged as `<name>.tmp/` and the manifest is written LAST,
+  then the staging directory is renamed into place (the same atomic
+  pattern `_write_npz` uses for single files): a generation without its
+  manifest — or still under its `.tmp` staging name — is uncommitted and
+  is skipped by :func:`verify_checkpoint`/:func:`latest_checkpoint`
+  exactly like a bit-flipped flat file.  **No process ever assembles the
+  global array**: save stages one O(local) block at a time, and
+  :func:`load_checkpoint` restores shard-by-shard — including the
+  *elastic* restore path, which re-tiles a generation written under a
+  DIFFERENT `dims`/device count onto the live decomposition
+  (`redistribute=True`) by per-target-block global indexing (overlaps
+  stripped, halos reconstructed, periodic wrap and open-boundary
+  user-owned planes preserved), never holding more than a couple of
+  shards in host memory.
+
+Restore validates the geometry against the live grid and fails loudly on
+any mismatch; pass `redistribute=True` to :func:`load_checkpoint` to
+re-tile either format onto the current decomposition (the flat path
+materializes the global interior on each process; the sharded path
+streams).  Periodicity and per-array stagger must match — redistribution
+changes the decomposition, not the physics.
+
+Multi-controller runs: the sharded format needs a shared filesystem (the
+standard pod setup) — each process writes its own shards, process 0 waits
+for the full shard set and seals the generation with the manifest; no
+cross-process array collectives are involved, so saves can run from a
+background writer thread (:mod:`igg.resilience`).  The legacy flat format
+assembles the global array on process 0 only (root-biased chunked fetch;
+non-root host memory stays O(local) — see `igg.gather._fetch_global`).
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import logging
 import pathlib
 import re
 import zlib
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import shared
-from .shared import GridError
+from .shared import GridError, NDIMS
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
-           "verify_checkpoint", "checkpoint_step", "list_generations"]
+__all__ = ["save_checkpoint", "save_checkpoint_sharded", "load_checkpoint",
+           "latest_checkpoint", "verify_checkpoint",
+           "verify_checkpoint_distributed", "checkpoint_step",
+           "list_generations", "remove_generation"]
+
+_log = logging.getLogger("igg.checkpoint")
 
 _META_KEY = "__igg_meta__"
+# Attempt handshake inside a staging dir (all filesystem, no collectives):
+# peer process p publishes `hello_<p>` holding a fresh per-call nonce, and
+# process 0 — which cleared any dead attempt's leftovers BEFORE it answers
+# anything — replies with `ack_<p>` echoing that nonce plus this attempt's
+# token.  A peer trusts only an ack echoing ITS OWN nonce: the nonce did
+# not exist before the peer entered the call, so the echoing process 0 is
+# provably live and past its clear, and the token in the ack is provably
+# this attempt's — a dead attempt's stale staging dir (token, acks, shards
+# and all) can never satisfy the handshake, no matter how the relaunch
+# interleaves with process 0's cleanup.  The commit wait then matches the
+# sealed manifest against the same token, so neither stale shards nor a
+# pre-existing committed generation satisfies either side.
+_HELLO = "hello_{:05d}"
+_ACK = "ack_{:05d}"
+# Third leg: the peer confirms it HAS the token (`done_<p>`), and process 0
+# seals only after every peer's confirmation (plus the full shard set) —
+# without it, a peer owning no shard files (all fields rank < 3) that says
+# hello after the shard set completes would never be answered and would
+# time out against a staging dir that no longer exists.
+_DONE = "done_{:05d}"
+# Marker name older igg versions staged (still recognized when sweeping
+# their orphaned staging dirs).
+_ATTEMPT = "attempt.token"
 
-# One-time memory-cliff warning flag (multi-controller checkpoint
-# materializes every field's global array on every process).
-_warned_ckpt_cliff = False
+# Sharded-generation layout constants.
+_MANIFEST = "manifest.json"
+_FORMAT = "igg-sharded-v1"
 
-# One-time warning flag for sweeping stale `*.tmp` files a crashed run left
-# behind mid-`_write_npz`.
+# One-shot debug-log guard: a multi-controller run taking the LEGACY flat
+# `.npz` path (root still assembles the global array; the sharded format
+# doesn't).  The old one-time memory-cliff UserWarning is retired — the
+# root-biased fetch keeps non-root host memory at O(local) even here.
+_logged_flat_fallback = False
+
+# One-time warning flag for sweeping stale `*.tmp` staging files/dirs a
+# crashed writer left behind mid-`_write_npz`/mid-commit.
 _warned_stale_tmp = False
 
 
@@ -83,27 +156,55 @@ def _meta(grid) -> dict:
 _STALE_TMP_AGE_S = 300.0
 
 
+def _is_staging_dir(p: pathlib.Path) -> bool:
+    """Whether a `*.tmp` directory has the exact shape
+    :func:`save_checkpoint_sharded` stages — only `shard_*.npz` files
+    (possibly with their own `.tmp` staging suffix) and the manifest.
+    Anything else means the directory is NOT ours and must never be swept
+    from a shared checkpoint directory."""
+    try:
+        entries = list(p.iterdir())
+    except OSError:
+        return False
+    for e in entries:
+        if not (re.fullmatch(
+                    r"(shard_\d+\.npz|hello_\d+|ack_\d+|done_\d+)(\.tmp)?",
+                    e.name)
+                or e.name in (_MANIFEST, _MANIFEST + ".tmp",
+                              _ATTEMPT, _ATTEMPT + ".tmp")):
+            return False
+    return True
+
+
 def _sweep_stale_tmp(parent: pathlib.Path) -> None:
-    """Remove old `*.npz.tmp` files left in the checkpoint directory by a
-    crash mid-`_write_npz` (the atomic-rename pattern never publishes them,
-    so any that exist are garbage from a dead writer).  Two guards keep the
-    sweep from touching files it does not own: only the `*.npz.tmp` shape
-    `_write_npz` stages (a suffix-less checkpoint path leaves a `*.tmp`
-    unswept — rare and harmless — rather than risk deleting another tool's
-    temp file from a shared directory), and only files older than
-    `_STALE_TMP_AGE_S` — a young one may be a live concurrent writer
-    mid-write, and unlinking it would make its `os.replace` fail.  Warns
-    once per process."""
+    """Remove old `*.npz.tmp` files AND orphaned `*.tmp` generation
+    directories left in the checkpoint directory by a crash mid-write
+    (`_write_npz`'s atomic rename and the sharded commit both stage under
+    `.tmp` names and never publish them, so any that exist are garbage
+    from a dead writer).  Two guards keep the sweep from touching state it
+    does not own: only the exact shapes this module stages — `*.npz.tmp`
+    files and staging directories holding nothing but `shard_*.npz` /
+    manifest entries (another tool's temp file or directory in a shared
+    checkpoint dir is never deleted) — and only entries older than
+    `_STALE_TMP_AGE_S`, since a young one may belong to a LIVE concurrent
+    writer mid-write/mid-commit, and removing it would make that writer's
+    `os.replace` fail.  Warns once per process."""
+    import shutil
     import time
 
     global _warned_stale_tmp
 
     now = time.time()
     stale = []
-    for p in sorted(parent.glob("*.npz.tmp")):
+    for p in sorted(parent.glob("*.tmp")):
         try:
+            is_dir = p.is_dir()
+            if is_dir and not _is_staging_dir(p):
+                continue
+            if not is_dir and not p.name.endswith(".npz.tmp"):
+                continue
             if now - p.stat().st_mtime >= _STALE_TMP_AGE_S:
-                stale.append(p)
+                stale.append((p, is_dir))
         except OSError:
             pass   # vanished under us (its writer finished or swept it)
     if not stale:
@@ -113,13 +214,14 @@ def _sweep_stale_tmp(parent: pathlib.Path) -> None:
 
         _warned_stale_tmp = True
         warnings.warn(
-            f"igg.save_checkpoint: sweeping {len(stale)} stale .tmp file(s) "
-            f"left by a crashed writer in {parent} (e.g. {stale[0].name}); "
-            f"checkpoints publish atomically, so .tmp files are never valid "
-            f"state.  (Warned once per process.)", stacklevel=3)
-    for p in stale:
+            f"igg.save_checkpoint: sweeping {len(stale)} stale .tmp "
+            f"file(s)/staging dir(s) left by a crashed writer in {parent} "
+            f"(e.g. {stale[0][0].name}); checkpoints publish atomically, so "
+            f".tmp entries are never valid state.  (Warned once per "
+            f"process.)", stacklevel=3)
+    for p, is_dir in stale:
         try:
-            p.unlink()
+            shutil.rmtree(p) if is_dir else p.unlink()
         except OSError:
             pass  # another process swept it first
 
@@ -145,12 +247,39 @@ def _write_npz(path, arrays: Dict[str, np.ndarray]) -> None:
     os.replace(tmp, path)
 
 
+def _encode(arr: np.ndarray) -> np.ndarray:
+    """Extension dtypes (bfloat16, float8_*) have no portable npy descr;
+    store the raw bytes (the true dtype name travels in the meta/manifest
+    `dtypes` entry and is viewed back on load)."""
+    if arr.dtype.kind == "V" or arr.dtype.str.startswith("|V"):
+        return arr.view(np.uint8)
+    return arr
+
+
+def _decode(arr: np.ndarray, want: Optional[str], path, name: str):
+    """View a stored array back to its true dtype per the manifest; a
+    malformed dtypes entry reads as a corrupt checkpoint, never a raw
+    TypeError/ValueError."""
+    try:
+        w = np.dtype(want) if want is not None else arr.dtype
+        if arr.dtype != w:
+            arr = arr.view(w)
+    except (TypeError, ValueError) as e:
+        raise GridError(
+            f"load_checkpoint: corrupt dtypes manifest for field "
+            f"{name!r} in {path} ({e}).") from e
+    return arr
+
+
 def save_checkpoint(path, /, **fields) -> None:
-    """Write the named grid fields and the grid geometry to `path` (.npz).
+    """Write the named grid fields and the grid geometry to `path` (.npz) —
+    the legacy FLAT single-file format (see
+    :func:`save_checkpoint_sharded` for the O(local) generation-directory
+    format the resilience ring uses by default).
 
     Fields are full block-stacked global arrays (any stagger, any dtype);
     every process participates (multi-controller shards are exchanged over
-    the runtime), process 0 writes.
+    the runtime, root-biased — only process 0 assembles), process 0 writes.
     """
     import jax
 
@@ -161,20 +290,16 @@ def save_checkpoint(path, /, **fields) -> None:
     if not fields:
         raise GridError("save_checkpoint: no fields given.")
 
-    global _warned_ckpt_cliff
-    if jax.process_count() > 1 and not _warned_ckpt_cliff:
-        import warnings
-
-        _warned_ckpt_cliff = True
-        total = sum(int(getattr(A, "nbytes", 0)) for A in fields.values())
-        warnings.warn(
-            f"igg.save_checkpoint: on a multi-controller run every "
-            f"process materializes the full global array of every field "
-            f"(~{total / 2**20:.0f} MiB total here) in host memory "
-            f"simultaneously — the allgather memory cliff documented in "
-            f"docs/multihost.md.  Checkpoint fewer fields per call, or "
-            f"space out the cadence, if hosts are memory-tight.  (Warned "
-            f"once per process.)", stacklevel=2)
+    global _logged_flat_fallback
+    if jax.process_count() > 1 and not _logged_flat_fallback:
+        _logged_flat_fallback = True
+        _log.debug(
+            "igg.save_checkpoint: legacy flat-.npz checkpoint on a "
+            "multi-controller run — the global array is assembled on "
+            "process 0 only (root-biased chunked fetch; non-root host "
+            "memory stays O(local)).  Prefer save_checkpoint_sharded / "
+            "run_resilient(sharded=True): per-process shard writes, no "
+            "global assembly anywhere.")
 
     host: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
@@ -182,13 +307,10 @@ def save_checkpoint(path, /, **fields) -> None:
         if name == _META_KEY:
             raise GridError(f"save_checkpoint: field name {_META_KEY!r} is "
                             f"reserved.")
-        arr = np.ascontiguousarray(_fetch_global(A))
-        dtypes[name] = str(arr.dtype)
-        if arr.dtype.kind == "V" or arr.dtype.str.startswith("|V"):
-            # Extension dtypes (bfloat16, float8_*) have no portable npy
-            # descr; store the raw bytes and the true dtype name in meta.
-            arr = arr.view(np.uint8)
-        host[name] = arr
+        dtypes[name] = str(np.dtype(A.dtype))
+        arr = _fetch_global(A)   # None on non-root multi-controller ranks
+        if arr is not None:
+            host[name] = _encode(np.ascontiguousarray(arr))
 
     if jax.process_index() == 0:
         path = pathlib.Path(path)
@@ -207,8 +329,10 @@ def save_checkpoint(path, /, **fields) -> None:
 
 
 def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
-    """Read a checkpoint written by :func:`save_checkpoint` and return
-    `{name: sharded jax.Array}` on the CURRENT grid.
+    """Read a checkpoint written by :func:`save_checkpoint` (flat `.npz`
+    file) or :func:`save_checkpoint_sharded` (generation directory — the
+    format is auto-detected) and return `{name: sharded jax.Array}` on the
+    CURRENT grid.
 
     By default the current grid must have the geometry the checkpoint was
     written under (validated; `GridError` on mismatch).  With
@@ -220,15 +344,21 @@ def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
     halo cells included — is reconstructed by global indexing with
     periodic wrap, which reproduces exactly what an `update_halo` on
     globally-consistent data would give, bit for bit.  Periodicity and
-    per-array stagger must match; `dims`, local sizes, and overlaps may
-    all differ."""
+    per-array stagger must match; `dims`, local sizes, overlaps, and the
+    device count may all differ.  On a sharded generation this ELASTIC
+    restore streams shard-by-shard (a bounded cache of O(local) blocks) —
+    no process ever materializes the global array; the flat path
+    materializes the stacked array per process (legacy behavior)."""
     import jax
 
     from .fields import sharding_for
 
     shared.check_initialized()
     grid = shared.global_grid()
-    meta, arrays = _read_verified(pathlib.Path(path))
+    path = pathlib.Path(path)
+    if path.is_dir():
+        return _load_sharded(path, grid, redistribute)
+    meta, arrays = _read_verified(path)
 
     mine = _meta(grid)
     same_geometry = {k: meta.get(k) for k in mine} == mine
@@ -248,14 +378,7 @@ def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
     dtypes = meta.get("dtypes", {})
     out = {}
     for name, arr in arrays.items():
-        try:
-            want = np.dtype(dtypes.get(name, str(arr.dtype)))
-            if arr.dtype != want:
-                arr = arr.view(want)   # extension dtypes stored as raw bytes
-        except (TypeError, ValueError) as e:
-            raise GridError(
-                f"load_checkpoint: corrupt dtypes manifest for field "
-                f"{name!r} in {path} ({e}).") from e
+        arr = _decode(arr, dtypes.get(name), path, name)
         if not same_geometry:
             arr = _redistribute(name, arr, meta, grid)
         out[name] = jax.device_put(arr, sharding_for(arr.ndim))
@@ -301,39 +424,14 @@ def _read_verified(path: pathlib.Path):
     return meta, arrays
 
 
-def verify_checkpoint(path, *, check_finite: bool = False) -> bool:
-    """Whether `path` is a readable, checksum-consistent checkpoint.
-
-    Reads every array and verifies the CRC32 manifest (files written before
-    the manifest existed verify structurally only).  With
-    `check_finite=True`, additionally require every floating/complex field
-    to be entirely finite — the health gate :mod:`igg.resilience` applies
-    when choosing a rollback generation, since a checkpoint written between
-    a NaN blowup and its detection is structurally perfect but poisoned.
-    Purely host-side (no grid needs to be initialized)."""
-    try:
-        meta, arrays = _read_verified(pathlib.Path(path))
-    except GridError:
-        return False
-    if not check_finite:
-        return True
-    dtypes = meta.get("dtypes", {})
-    for name, arr in arrays.items():
-        # A malformed dtypes manifest entry (version-skewed writer, damaged
-        # meta — the CRC32 manifest covers arrays, not itself) must read as
-        # "not a valid checkpoint", never escape as a raw TypeError/
-        # ValueError and kill the skip-corrupt fallback in the callers.
-        try:
-            want = np.dtype(dtypes.get(name, str(arr.dtype)))
-            if arr.dtype != want:
-                arr = arr.view(want)   # extension dtypes stored as raw bytes
-        except (TypeError, ValueError):
-            return False
-        if want.kind in "biu":
+def _all_finite(arrays: Dict[str, np.ndarray]) -> bool:
+    """The all-finite health gate over DECODED (true-dtype) arrays.
+    Integral dtypes pass trivially; f/c AND the kind-'V' extension floats
+    (bfloat16, float8_* — a kind check of "fc" would wave a NaN-poisoned
+    bf16 field through) go through np.isfinite via ml_dtypes."""
+    for arr in arrays.values():
+        if arr.dtype.kind in "biu":
             continue               # integral: always finite
-        # f/c AND the kind-'V' extension floats (bfloat16, float8_* — a
-        # kind check of "fc" would wave a NaN-poisoned bf16 field through
-        # the health gate); np.isfinite handles them via ml_dtypes.
         try:
             ok = bool(np.isfinite(arr).all())
         except TypeError:          # dtype without isfinite support
@@ -343,43 +441,225 @@ def verify_checkpoint(path, *, check_finite: bool = False) -> bool:
     return True
 
 
+def verify_checkpoint(path, *, check_finite: bool = False,
+                      part: Optional[Tuple[int, int]] = None) -> bool:
+    """Whether `path` is a readable, checksum-consistent checkpoint — a
+    flat `.npz` file or a sharded generation directory (auto-detected).
+
+    Reads every array and verifies the CRC32 manifest(s) (flat files
+    written before the manifest existed verify structurally only; a
+    sharded generation additionally requires its commit record — the
+    manifest written last — and every listed shard present and
+    summary-consistent).  With `check_finite=True`, additionally require
+    every floating/complex field to be entirely finite — the health gate
+    :mod:`igg.resilience` applies when choosing a rollback generation,
+    since a checkpoint written between a NaN blowup and its detection is
+    structurally perfect but poisoned.  `part=(i, n)` restricts a sharded
+    verification to every n-th shard starting at i (the distributed
+    round-robin of :func:`verify_checkpoint_distributed`; ignored for flat
+    files, which have no shards to split).  Purely host-side (no grid
+    needs to be initialized); peak staging on a sharded generation is one
+    shard."""
+    path = pathlib.Path(path)
+    if path.is_dir():
+        return _verify_sharded(path, check_finite=check_finite, part=part)
+    try:
+        meta, arrays = _read_verified(path)
+    except GridError:
+        return False
+    if not check_finite:
+        return True
+    dtypes = meta.get("dtypes", {})
+    try:
+        decoded = {n: _decode(a, dtypes.get(n), path, n)
+                   for n, a in arrays.items()}
+    except GridError:
+        # A malformed dtypes manifest entry (version-skewed writer, damaged
+        # meta — the CRC32 manifest covers arrays, not itself) must read as
+        # "not a valid checkpoint", never kill the skip-corrupt fallback in
+        # the callers.
+        return False
+    return _all_finite(decoded)
+
+
+def verify_checkpoint_distributed(path, *, check_finite: bool = False) -> bool:
+    """Collective variant of :func:`verify_checkpoint` for multi-controller
+    runs: each process verifies a round-robin subset of a sharded
+    generation's shards and the per-process verdicts are AND-combined, so
+    a pod-scale verification reads every shard ONCE globally instead of
+    once per process.  Must be called by every process (it is a
+    collective) and — unlike the purely host-side
+    :func:`verify_checkpoint` — needs the grid initialized on a
+    multi-controller run, since the verdict combine is one tiny SPMD
+    min-reduce over the grid mesh.  On a single process it is exactly
+    :func:`verify_checkpoint`.  A flat-file checkpoint is read whole by
+    every process (no shards to round-robin) but the verdict is STILL
+    combined: callers treat the result as collective-consistent (all
+    processes then load the same generation), and one process's transient
+    read failure must make every process skip the generation, not just
+    the one that saw it."""
+    import jax
+
+    path = pathlib.Path(path)
+    nproc = int(jax.process_count())
+    if nproc == 1:
+        return verify_checkpoint(path, check_finite=check_finite)
+    part = ((int(jax.process_index()), nproc) if path.is_dir() else None)
+    ok = verify_checkpoint(path, check_finite=check_finite, part=part)
+    return _combine_verdicts(ok)
+
+
+def _combine_min(val: int) -> int:
+    """Minimum of a per-process int32 value across every process: each
+    device of the grid mesh contributes its process's value and one
+    compiled min-reduce replicates the result — an SPMD program over the
+    mesh (works on every multi-controller backend), NOT
+    `process_allgather` of a host value (unimplemented on some).  The
+    combine primitive under both the verdict AND
+    (:func:`_combine_verdicts`) and the agreed-step probes of the
+    distributed generation scan (int32 so step numbers combine exactly;
+    float32 rounds past 2**24)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    from .shared import AXIS_NAMES
+
+    v = np.asarray([val], dtype=np.int32)
+    arr = jax.make_array_from_callback(
+        (grid.nprocs,),
+        NamedSharding(grid.mesh, PartitionSpec(tuple(AXIS_NAMES))),
+        lambda idx: v)
+    out = shared.replicating_jit(
+        jnp.min, NamedSharding(grid.mesh, PartitionSpec()))(arr)
+    return int(np.asarray(out.addressable_shards[0].data))
+
+
+def _combine_max(val: int) -> int:
+    """Maximum across processes (min-reduce of the negation)."""
+    return -_combine_min(-int(val))
+
+
+def _combine_verdicts(ok: bool) -> bool:
+    """AND a per-process verdict across every process (module comment at
+    :func:`_combine_min`)."""
+    return _combine_min(1 if ok else 0) > 0
+
+
 def checkpoint_step(path) -> Optional[int]:
-    """Step number encoded in a generation filename (`<prefix>_<step>.npz`,
-    the ring layout :mod:`igg.resilience` writes); None for non-generation
-    names."""
-    m = re.search(r"_(\d+)\.npz$", pathlib.Path(path).name)
+    """Step number encoded in a generation name (`<prefix>_<step>.npz`
+    flat file or `<prefix>_<step>` sharded directory, the ring layouts
+    :mod:`igg.resilience` writes); None for non-generation names (a
+    `.tmp`-staged directory included — it is uncommitted)."""
+    m = re.search(r"_(\d+)(?:\.npz)?$", pathlib.Path(path).name)
     return int(m.group(1)) if m else None
 
 
 def list_generations(directory, prefix: str = "ckpt"):
-    """All generation files `{prefix}_<digits>.npz` in `directory` as a
-    `[(step, path), ...]` list sorted by step (strict filename match — a
-    sibling ring under a longer prefix like 'ckpt_b' never matches).  The
-    single scan shared by :func:`latest_checkpoint` and the resilience
-    ring's pruning/rollback, so the two can never disagree on what a
-    generation is."""
+    """All generations — flat files `{prefix}_<digits>.npz` and sharded
+    directories `{prefix}_<digits>` — in `directory` as a `[(step, path),
+    ...]` list sorted by step (strict name match: a sibling ring under a
+    longer prefix like 'ckpt_b' never matches, and a `.tmp` staging
+    directory is not a generation).  The single scan shared by
+    :func:`latest_checkpoint` and the resilience ring's pruning/rollback,
+    so the two can never disagree on what a generation is."""
     directory = pathlib.Path(directory)
     gens = []
-    for p in directory.glob(f"{prefix}_*.npz"):
-        if re.fullmatch(re.escape(prefix) + r"_\d+\.npz", p.name):
+    for p in directory.glob(f"{prefix}_*"):
+        if re.fullmatch(re.escape(prefix) + r"_\d+(\.npz)?", p.name):
             gens.append((checkpoint_step(p), p))
     return sorted(gens)
 
 
+def remove_generation(path) -> None:
+    """Delete one generation, flat file or sharded directory (the unlink
+    shared by the resilience ring's pruning and its fresh-run clearing).
+    Already-gone paths are fine (another process pruned first)."""
+    import shutil
+
+    path = pathlib.Path(path)
+    try:
+        if path.is_dir():
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            path.unlink()
+    except OSError:
+        pass
+
+
 def latest_checkpoint(directory, prefix: str = "ckpt", *,
-                      check_finite: bool = False) -> Optional[pathlib.Path]:
+                      check_finite: bool = False,
+                      distributed: bool = False,
+                      max_step: Optional[int] = None
+                      ) -> Optional[pathlib.Path]:
     """Newest valid checkpoint generation in `directory`.
 
-    Scans `{prefix}_<step>.npz` files newest-first (by the step encoded in
-    the filename) and returns the first that passes
-    :func:`verify_checkpoint` — a truncated or corrupt newest generation is
-    skipped, falling back to the previous one.  Returns None when no valid
-    generation exists.  `check_finite` additionally skips generations
-    holding non-finite field values (resume-after-blowup safety)."""
-    for _, p in reversed(list_generations(directory, prefix)):
-        if verify_checkpoint(p, check_finite=check_finite):
-            return p
-    return None
+    Scans generations (flat `{prefix}_<step>.npz` files and sharded
+    `{prefix}_<step>` directories) newest-first by the step encoded in the
+    name and returns the first that passes :func:`verify_checkpoint` — a
+    truncated/corrupt/uncommitted newest generation is skipped, falling
+    back to the previous one.  Returns None when no valid generation
+    exists.  `check_finite` additionally skips generations holding
+    non-finite field values (resume-after-blowup safety); `max_step`
+    restricts the scan to generations at that step or older (the rollback
+    contract of :mod:`igg.resilience` — a generation younger than the
+    failing probe is post-failure noise).
+
+    `distributed=True` verifies each candidate through
+    :func:`verify_checkpoint_distributed` (each process reads a round-robin
+    subset of a sharded generation's shards instead of all of them).  It is
+    then a COLLECTIVE: every process must call it, and — because directory
+    listings can transiently diverge across hosts (NFS attribute caches) —
+    the candidate sequence is NOT each process's own listing: each probed
+    step is agreed globally first (a max-combine of the processes' newest
+    remaining candidates), so every process executes the same collectives
+    in the same order.  A generation one process cannot see verifies False
+    there and the AND-combine skips it everywhere — conservative, never
+    divergent."""
+    import jax
+
+    gens = [(s, p) for s, p in list_generations(directory, prefix)
+            if max_step is None or s <= max_step]
+    if not distributed or int(jax.process_count()) == 1:
+        # Every generation is a candidate — a step can hold BOTH artifacts
+        # (a sharded directory and a stale flat file from a sharded=False
+        # run); one of them failing must not mask the other.
+        for _, p in reversed(gens):
+            if (verify_checkpoint_distributed if distributed
+                    else verify_checkpoint)(p, check_finite=check_finite):
+                return p
+        return None
+
+    directory = pathlib.Path(directory)
+    steps = {s for s, _ in gens}
+    probe = None
+    while True:
+        below = probe if probe is not None else (
+            max_step + 1 if max_step is not None else 2**31 - 1)
+        mine = max((s for s in steps if s < below), default=-1)
+        probe = _combine_max(mine)
+        if probe < 0:
+            return None
+        # Both possible artifacts of the probed step are tried in a FIXED
+        # order (directory first, then flat file) so every process
+        # executes the same collectives; paths are constructed from the
+        # step, not the listing, so an entry a stale listing missed is
+        # still read.  An artifact any process cannot verify fails the
+        # combine — conservative, never divergent — and a combined pass
+        # guarantees every process verified (hence has) the SAME artifact.
+        for cand in (directory / f"{prefix}_{probe:09d}",
+                     directory / f"{prefix}_{probe:09d}.npz"):
+            is_dir = cand.is_dir()
+            ok = (cand.exists()
+                  and verify_checkpoint(cand, check_finite=check_finite,
+                                        part=((int(jax.process_index()),
+                                               int(jax.process_count()))
+                                              if is_dir else None)))
+            if _combine_verdicts(ok):
+                return cand
+        steps.discard(probe)
 
 
 def _redistribute(name: str, arr: np.ndarray, meta: dict, grid) -> np.ndarray:
@@ -431,4 +711,657 @@ def _redistribute(name: str, arr: np.ndarray, meta: dict, grid) -> np.ndarray:
             else c * (s_b - ol_b) + np.arange(s_b)
             for c in range(n_b)])
         out = np.take(out, idx, axis=d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded generation format (igg-sharded-v1)
+# ---------------------------------------------------------------------------
+#
+# {path}/                      <- committed by renaming {path}.tmp/ into place
+#   shard_00000.npz            <- one per grid block (cart rank), written by
+#   shard_00001.npz               the process addressing that block; every
+#   ...                           field's LOCAL block, halo cells included,
+#                                 plus a per-shard __igg_meta__ CRC32 manifest
+#   manifest.json              <- process 0, written LAST: the commit record
+#                                 (grid geometry, per-field dtypes and local
+#                                 shapes, per-shard CRC32 summaries)
+#
+# Fields of rank < 3 are replicated over the trailing mesh axes; their block
+# lives in the shard of the rank with trailing coords 0, so exactly one
+# process owns every shard file.
+
+
+def _shard_name(rank: int) -> str:
+    return f"shard_{rank:05d}.npz"
+
+
+def _summary_crc(crcs: Dict[str, int]) -> int:
+    """One CRC32 summarizing a shard's per-field CRC32 map — what the
+    top-level manifest records per shard, tying each shard file to the
+    generation that wrote it without re-hashing the array bytes."""
+    return int(zlib.crc32(json.dumps(
+        {k: int(v) for k, v in sorted(crcs.items())}).encode()))
+
+
+def _ranks_for_field(grid, ndim: int):
+    """Shard ranks holding blocks of a rank-`ndim` field: all coordinates
+    over the first min(ndim, NDIMS) mesh axes, trailing coords 0."""
+    nd = min(ndim, NDIMS)
+    for coords in itertools.product(
+            *[range(grid.dims[d]) for d in range(nd)]):
+        yield grid.cart_rank(tuple(coords) + (0,) * (NDIMS - nd))
+
+
+def _expected_shards(grid, field_ndims) -> List[int]:
+    ranks = set()
+    for nd in field_ndims:
+        ranks.update(_ranks_for_field(grid, nd))
+    return sorted(ranks)
+
+
+def _local_block_refs(grid, fields) -> Dict[int, Dict[str, object]]:
+    """{shard rank: {field: device-resident block}} for every block THIS
+    process addresses.  References only — no device→host transfer happens
+    here, so a caller (the background checkpoint writer) can poll readiness
+    before fetching.  Lower-rank fields are replicated over the trailing
+    mesh axes; only the copy on the trailing-coords-0 device is taken, so
+    each shard file has exactly one writer."""
+    devpos = {dev: pos for pos, dev in np.ndenumerate(grid.mesh.devices)}
+    refs: Dict[int, Dict[str, object]] = {}
+    for name, A in fields.items():
+        local = grid.local_shape(A)
+        nd = min(A.ndim, NDIMS)
+        for sh in A.addressable_shards:
+            pos = devpos.get(sh.device)
+            if pos is None or any(pos[k] != 0 for k in range(nd, NDIMS)):
+                continue   # a replica off the trailing-0 plane (or a device
+                           # outside the grid mesh): not this shard's owner
+            coords = tuple((sh.index[d].start or 0) // local[d]
+                           for d in range(nd))
+            rank = grid.cart_rank(coords + (0,) * (NDIMS - nd))
+            refs.setdefault(rank, {})[name] = sh.data
+    return refs
+
+
+def _commit_timeout_s() -> float:
+    import os
+
+    return float(os.environ.get("IGG_CKPT_COMMIT_TIMEOUT", "600"))
+
+
+def _await_files(base: pathlib.Path, names, what: str,
+                 on_poll=None) -> None:
+    """Poll a shared filesystem until every `base/name` exists (the
+    cross-process coordination of the sharded commit — no device
+    collectives, so it is safe from a background writer thread).
+    `on_poll` runs once per poll round (process 0 answers late peer
+    hellos with it).  Raises `GridError` naming the missing entries after
+    `IGG_CKPT_COMMIT_TIMEOUT` seconds (default 600)."""
+    import time
+
+    deadline = time.monotonic() + _commit_timeout_s()
+    missing = list(names)
+    while True:
+        if on_poll is not None:
+            on_poll()
+        missing = [n for n in missing if not (base / n).exists()]
+        if not missing:
+            return
+        if time.monotonic() >= deadline:
+            raise GridError(
+                f"save_checkpoint_sharded: timed out after "
+                f"{_commit_timeout_s():g}s (IGG_CKPT_COMMIT_TIMEOUT) waiting "
+                f"for {len(missing)} {what} entr(ies) under {base} "
+                f"(e.g. {missing[0]}) — a peer process died mid-write, or "
+                f"the checkpoint directory is not a shared filesystem.")
+        time.sleep(0.05)
+
+
+def _write_atomic_text(p: pathlib.Path, text: str) -> None:
+    import os
+
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, p)
+
+
+def _ack_hellos(staging: pathlib.Path, token: str) -> None:
+    """Process 0's side of the attempt handshake (module comment at
+    `_HELLO`): answer every peer hello whose nonce is not yet acked with
+    `ack_<p>` = nonce + this attempt's token.  Idempotent and cheap (one
+    directory scan plus tiny atomic writes); called right after the
+    staging dir is created, between process 0's own shard writes, and from
+    every poll of the shard wait, so a peer arriving at any point before
+    the seal gets answered."""
+    try:
+        entries = list(staging.iterdir())
+    except OSError:
+        return
+    for e in entries:
+        m = re.fullmatch(r"hello_(\d+)", e.name)
+        if not m:
+            continue
+        try:
+            nonce = e.read_text()
+        except OSError:
+            continue   # mid-replace; the next poll answers it
+        ack = staging / _ACK.format(int(m.group(1)))
+        try:
+            if ack.read_text().split("\n", 1)[0] == nonce:
+                continue   # this nonce is already answered
+        except (OSError, ValueError):
+            pass
+        _write_atomic_text(ack, f"{nonce}\n{token}")
+
+
+def _peer_handshake(staging: pathlib.Path, proc: int) -> str:
+    """A non-root process's side of the attempt handshake (module comment
+    at `_HELLO`): publish a fresh nonce as `hello_<proc>`, poll for the
+    `ack_<proc>` echoing it, and return the attempt token the ack
+    carries.  The hello is re-published whenever it is found missing or
+    holding another nonce — process 0's stale-attempt clear sweeps any
+    copy that landed in a dead attempt's staging dir — and an ack echoing
+    any OTHER nonce (a dead attempt's leftover) is ignored, so only a
+    process 0 that is live in THIS save can complete the handshake.
+    Raises `GridError` after `IGG_CKPT_COMMIT_TIMEOUT` seconds."""
+    import time
+    import uuid
+
+    nonce = uuid.uuid4().hex
+    hello = staging / _HELLO.format(proc)
+    ack = staging / _ACK.format(proc)
+    deadline = time.monotonic() + _commit_timeout_s()
+    while True:
+        try:
+            published = hello.read_text() == nonce
+        except OSError:
+            published = False
+        if not published:
+            try:
+                _write_atomic_text(hello, nonce)
+            except OSError:
+                pass   # staging dir not created yet, or just cleared
+        try:
+            got, tok = ack.read_text().split("\n", 1)
+            if got == nonce:
+                # Confirm receipt: process 0 seals only after every peer's
+                # `done` file, so no peer is left mid-handshake against a
+                # staging dir that gets renamed away (module comment at
+                # `_DONE`).  The dir is provably live here — the ack came
+                # from a process 0 past its clear.
+                _write_atomic_text(staging / _DONE.format(proc), nonce)
+                return tok
+        except (OSError, ValueError):
+            pass
+        if time.monotonic() >= deadline:
+            raise GridError(
+                f"save_checkpoint_sharded: timed out after "
+                f"{_commit_timeout_s():g}s (IGG_CKPT_COMMIT_TIMEOUT) "
+                f"waiting for process 0 to acknowledge this process's "
+                f"attempt handshake under {staging} — process 0 died "
+                f"before sealing this save, or the checkpoint directory "
+                f"is not a shared filesystem.")
+        time.sleep(0.05)
+
+
+def _await_commit(path: pathlib.Path, token: str) -> None:
+    """Poll until the generation at `path` is sealed by THIS attempt — a
+    readable manifest whose ``attempt`` entry matches `token`.  Manifest
+    presence alone is not enough: a previously committed generation can
+    already sit at `path` while process 0 is still sealing the new one."""
+    import time
+
+    deadline = time.monotonic() + _commit_timeout_s()
+    while True:
+        try:
+            man = json.loads((path / _MANIFEST).read_text())
+            if man.get("attempt") == token:
+                return
+        except (OSError, json.JSONDecodeError):
+            pass   # not committed yet (or mid-replace of the old gen)
+        if time.monotonic() >= deadline:
+            raise GridError(
+                f"save_checkpoint_sharded: timed out after "
+                f"{_commit_timeout_s():g}s (IGG_CKPT_COMMIT_TIMEOUT) waiting "
+                f"for process 0 to commit {path} (attempt {token[:8]}…) — "
+                f"process 0 died mid-seal, or the checkpoint directory is "
+                f"not a shared filesystem.")
+        time.sleep(0.05)
+
+
+def save_checkpoint_sharded(path, /, **fields) -> None:
+    """Write the named grid fields as a sharded generation DIRECTORY at
+    `path` (module docstring for the format).  Every process writes only
+    its own local blocks — one `shard_<rank>.npz` per grid block, staged
+    one O(local) block at a time — and process 0 seals the generation with
+    the manifest (written last) and the atomic `.tmp`-dir rename.  No
+    process ever assembles the global array, and no device collectives are
+    involved (multi-controller coordination is filesystem-based), so this
+    is safe to call from a background writer thread."""
+    import jax
+
+    from .gather import _CHUNK_BYTES, _slabbed_get
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    if not fields:
+        raise GridError("save_checkpoint_sharded: no fields given.")
+    for name in fields:
+        if name == _META_KEY:
+            raise GridError(f"save_checkpoint_sharded: field name "
+                            f"{_META_KEY!r} is reserved.")
+    path = pathlib.Path(path)
+    if path.suffix == ".npz":
+        raise GridError(
+            "save_checkpoint_sharded: a sharded checkpoint is a DIRECTORY "
+            "generation; pass a path without the .npz suffix "
+            "(save_checkpoint writes the flat single-file format).")
+
+    import os
+    import shutil
+    import uuid
+
+    proc0 = int(jax.process_index()) == 0
+    staging = path.with_name(path.name + ".tmp")
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if proc0:
+        _sweep_stale_tmp(path.parent)
+        # A staging dir already at this exact name is a dead attempt's
+        # leftover (commits rename it away atomically, and a dead peer
+        # process stalls the whole multi-controller job, so no live writer
+        # can still own it).  Clear it BEFORE answering any peer hello: a
+        # stale shard that survived here could otherwise satisfy the shard
+        # wait below and be sealed — CRC-consistent but from the wrong
+        # attempt — into the manifest.
+        if staging.is_dir():
+            shutil.rmtree(staging)
+        elif staging.exists():
+            staging.unlink()
+        staging.mkdir()
+        token = uuid.uuid4().hex
+        # Answer peers that already said hello so their shard writes
+        # overlap ours; late arrivals are answered between our own shard
+        # writes and from the shard-wait polls below.
+        _ack_hellos(staging, token)
+    else:
+        # Peers write nothing until the handshake proves process 0 has
+        # cleared stale attempts and issued THIS attempt's token — writing
+        # earlier would race the clear above and lose fresh shards to the
+        # rmtree (and a stale token would desynchronize the commit wait).
+        token = _peer_handshake(staging, int(jax.process_index()))
+
+    dtypes = {n: str(np.dtype(A.dtype)) for n, A in fields.items()}
+    local_shapes = {n: [int(v) for v in grid.local_shape(A)]
+                    for n, A in fields.items()}
+    refs = _local_block_refs(grid, fields)
+    my_crcs: Dict[int, Dict[str, int]] = {}
+    for rank in sorted(refs):
+        # One shard at a time: fetch (largest-dim slabs above _CHUNK_BYTES),
+        # CRC, write, release — peak host staging is one block set.
+        host: Dict[str, np.ndarray] = {}
+        crcs: Dict[str, int] = {}
+        for name in sorted(refs[rank]):
+            arr = _encode(np.ascontiguousarray(
+                _slabbed_get(refs[rank][name], _CHUNK_BYTES)))
+            crcs[name] = _crc32(arr)
+            host[name] = arr
+        smeta = {"shard": rank, "coords": list(grid.cart_coords(rank)),
+                 "dtypes": {n: dtypes[n] for n in host}, "crc32": crcs}
+        _write_npz(staging / _shard_name(rank), {
+            **host, _META_KEY: np.frombuffer(
+                json.dumps(smeta).encode(), dtype=np.uint8)})
+        my_crcs[rank] = crcs
+        if proc0:
+            _ack_hellos(staging, token)   # answer peers between our writes
+
+    expected = _expected_shards(grid, [A.ndim for A in fields.values()])
+    if proc0:
+        # Peers write their shards to the shared filesystem; wait for the
+        # full set (each published atomically, so visible == complete;
+        # the entry clear above guarantees every file here is THIS
+        # attempt's) AND for every peer's handshake confirmation — a peer
+        # owning no shard files must still complete its handshake before
+        # the staging dir is renamed away.  Then seal: manifest written
+        # last, then the commit rename.  The handshake files have done
+        # their job and do not belong in the committed format.
+        _await_files(staging,
+                     [_shard_name(r) for r in expected]
+                     + [_DONE.format(p)
+                        for p in range(1, int(jax.process_count()))],
+                     "shard/handshake",
+                     on_poll=lambda: _ack_hellos(staging, token))
+        shards = {}
+        for r in expected:
+            crcs = my_crcs.get(r)
+            if crcs is None:
+                crcs = _read_shard_meta(staging / _shard_name(r)).get(
+                    "crc32", {})
+            shards[_shard_name(r)] = _summary_crc(crcs)
+        for e in list(staging.iterdir()):
+            if re.fullmatch(r"(hello_\d+|ack_\d+|done_\d+)(\.tmp)?", e.name):
+                e.unlink()
+        manifest = {"format": _FORMAT, **_meta(grid), "dtypes": dtypes,
+                    "local_shapes": local_shapes, "shards": shards,
+                    "attempt": token}
+        _write_atomic_text(staging / _MANIFEST, json.dumps(manifest))
+        # Commit.  `os.replace` cannot atomically replace a non-empty
+        # directory, so an existing committed generation at `path` is
+        # RENAMED aside (atomic) rather than deleted in place: the crash
+        # window in which neither the old nor the new generation sits at
+        # `path` is two renames, not an rmtree of a many-GB shard set —
+        # and the aside copy (a `.tmp` name, so the stale-staging sweep
+        # reclaims it after a crash) still holds the old committed data
+        # until the new generation is in place.
+        if path.exists():
+            aside = path.with_name(path.name + ".old.tmp")
+            if aside.is_dir():
+                shutil.rmtree(aside)
+            elif aside.exists():
+                aside.unlink()
+            os.replace(path, aside)
+            os.replace(staging, path)
+            remove_generation(aside)
+        else:
+            os.replace(staging, path)
+    else:
+        # No process may return (and possibly reload the generation) before
+        # it is committed — and only THIS attempt's commit counts: a
+        # committed generation already sitting at `path` (e.g. resuming a
+        # replay over an earlier, possibly poisoned, save of the same step)
+        # carries a different token and keeps the wait pending.
+        _await_commit(path, token)
+
+
+def _read_shard_meta(p: pathlib.Path) -> dict:
+    """Just the `__igg_meta__` entry of one shard file (a central-directory
+    seek plus one small member — the array payloads are not read)."""
+    import zipfile
+
+    try:
+        with np.load(p) as z:
+            if _META_KEY not in z.files:
+                raise GridError(
+                    f"checkpoint shard {p} has no {_META_KEY!r} entry — not "
+                    f"an igg shard (or truncated before its manifest).")
+            return json.loads(bytes(z[_META_KEY].tobytes()).decode())
+    except GridError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError, KeyError,
+            json.JSONDecodeError) as e:
+        raise GridError(
+            f"cannot read checkpoint shard {p}: {type(e).__name__}: {e} — "
+            f"missing, truncated, or corrupt.") from e
+
+
+def _read_shard(gen: pathlib.Path, fname: str, man: Optional[dict] = None):
+    """Read and fully verify one shard file of a generation: per-field
+    CRC32s against the shard's own manifest (REQUIRED in the sharded
+    format), the summary CRC against the generation manifest, and — when
+    `man` is given — shapes against the recorded local shapes.  Returns
+    `(shard_meta, {field: np array in its TRUE dtype})`; raises `GridError`
+    naming the path for anything inconsistent."""
+    import zipfile
+
+    p = gen / fname
+    try:
+        with np.load(p) as z:
+            if _META_KEY not in z.files:
+                raise GridError(
+                    f"load_checkpoint: shard {p} has no {_META_KEY!r} entry "
+                    f"— not an igg shard (or truncated).")
+            smeta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    except GridError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError, KeyError,
+            json.JSONDecodeError) as e:
+        raise GridError(
+            f"load_checkpoint: cannot read shard {p}: {type(e).__name__}: "
+            f"{e} — missing, truncated, or corrupt (an uncommitted or "
+            f"damaged generation).") from e
+
+    crcs = smeta.get("crc32", {})
+    for name, arr in arrays.items():
+        want = crcs.get(name)
+        if want is None or _crc32(arr) != want:
+            raise GridError(
+                f"load_checkpoint: CRC32 mismatch for field {name!r} in "
+                f"shard {p} — the shard is corrupt.")
+    if man is not None:
+        if _summary_crc(crcs) != man["shards"].get(fname):
+            raise GridError(
+                f"load_checkpoint: shard {p} disagrees with the generation "
+                f"manifest (summary CRC32) — the shard belongs to a "
+                f"different write or was swapped.")
+    dt = (man or smeta).get("dtypes", {})
+    out = {}
+    for name, arr in arrays.items():
+        arr = _decode(arr, dt.get(name), p, name)
+        if man is not None:
+            want_shape = tuple(man.get("local_shapes", {}).get(name, arr.shape))
+            if tuple(arr.shape) != want_shape:
+                raise GridError(
+                    f"load_checkpoint: field {name!r} in shard {p} has "
+                    f"shape {tuple(arr.shape)}, manifest says {want_shape} "
+                    f"— the shard is inconsistent with its generation.")
+        out[name] = arr
+    return smeta, out
+
+
+def _read_manifest_verified(path: pathlib.Path) -> dict:
+    """The generation manifest — the commit record.  A directory without
+    one is an UNCOMMITTED generation (crashed between shard writes and the
+    seal) and reads as invalid, exactly like a truncated flat file."""
+    mp = path / _MANIFEST
+    try:
+        man = json.loads(mp.read_text())
+    except FileNotFoundError:
+        raise GridError(
+            f"load_checkpoint: {path} has no {_MANIFEST} — an uncommitted "
+            f"(crashed or preempted mid-commit) sharded generation, not a "
+            f"valid checkpoint.") from None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise GridError(
+            f"load_checkpoint: cannot read {mp}: {type(e).__name__}: {e} — "
+            f"the generation manifest is corrupt.") from e
+    if man.get("format") != _FORMAT:
+        raise GridError(
+            f"load_checkpoint: {mp} has format {man.get('format')!r}, "
+            f"expected {_FORMAT!r}.")
+    for key in ("nxyz", "dims", "overlaps", "periods", "nprocs", "dtypes",
+                "local_shapes", "shards"):
+        if key not in man:
+            raise GridError(
+                f"load_checkpoint: {mp} is missing the {key!r} entry — the "
+                f"generation manifest is corrupt.")
+    return man
+
+
+def _verify_sharded(path: pathlib.Path, *, check_finite: bool,
+                    part: Optional[Tuple[int, int]] = None) -> bool:
+    """Directory branch of :func:`verify_checkpoint`: manifest present and
+    well-formed, every (selected) shard present, readable, and CRC- and
+    summary-consistent; `check_finite` gates each shard's decoded arrays —
+    one shard in memory at a time."""
+    try:
+        man = _read_manifest_verified(path)
+    except GridError:
+        return False
+    names = sorted(man["shards"])
+    if part is not None:
+        i, n = part
+        names = names[i::n]
+    for fname in names:
+        try:
+            _, arrays = _read_shard(path, fname, man)
+        except GridError:
+            return False
+        if check_finite and not _all_finite(arrays):
+            return False
+    return True
+
+
+class _ShardCache:
+    """Bounded LRU of decoded, verified shard files — the streaming unit of
+    the sharded load paths.  Peak host staging is `limit` shards plus the
+    one target block being assembled, never the global array."""
+
+    def __init__(self, path: pathlib.Path, man: dict, limit: int = 4):
+        import threading
+
+        self._path, self._man, self._limit = path, man, limit
+        self._cache: Dict[str, Dict[str, np.ndarray]] = {}
+        # The restore callback may be driven concurrently by the runtime;
+        # the LRU bookkeeping is not atomic without this.
+        self._lock = threading.Lock()
+
+    def get(self, rank: int) -> Dict[str, np.ndarray]:
+        fname = _shard_name(rank)
+        with self._lock:
+            if fname in self._cache:
+                self._cache[fname] = self._cache.pop(fname)   # LRU touch
+                return self._cache[fname]
+        if fname not in self._man["shards"]:
+            raise GridError(
+                f"load_checkpoint: generation {self._path} has no shard "
+                f"{fname} — the manifest does not cover this block.")
+        _, arrays = _read_shard(self._path, fname, self._man)
+        with self._lock:
+            while len(self._cache) >= self._limit:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[fname] = arrays
+        return arrays
+
+
+def _elastic_params(name: str, src_local, tgt_local, man: dict, grid):
+    """Per-sharded-dim re-tiling parameters from the checkpoint's
+    decomposition onto the live grid — the same plane algebra as the flat
+    :func:`_redistribute`, validated up front: de-duplicated global sizes
+    must agree (the physical domain is decomposition-invariant)."""
+    params = []
+    for d in range(min(len(tgt_local), NDIMS)):
+        df = src_local[d] - man["nxyz"][d]
+        s_s, s_b = src_local[d], tgt_local[d]
+        ol_s = man["overlaps"][d] + df
+        ol_b = grid.overlaps[d] + df
+        n_s, n_b = man["dims"][d], grid.dims[d]
+        periodic = bool(man["periods"][d])
+        keep_s = s_s - max(ol_s, 0)
+        size = n_s * keep_s + (0 if periodic else max(ol_s, 0))
+        want = n_b * (s_b - ol_b) + (0 if periodic else ol_b)
+        if size != want:
+            raise GridError(
+                f"load_checkpoint(redistribute=True): field '{name}' has "
+                f"{size} unique cells along dim {d} but the current grid "
+                f"needs {want}; the global physical domain must match.")
+        params.append(dict(keep_s=keep_s, size=size, stride_b=s_b - ol_b,
+                           n_s=n_s, s_b=s_b, periodic=periodic))
+    return params
+
+
+def _assemble_block(name: str, cache: _ShardCache, man: dict, params,
+                    coords, tgt_local, dtype) -> np.ndarray:
+    """Reconstruct ONE target block (halo cells included) of a field from
+    the source shards, by global indexing.  Target stacked index `i` of
+    block `c` is global interior cell `g = c*(s_b - ol_b) + i` (wrapped on
+    periodic dims); cell `g` is owned by source block
+    `min(g // keep_s, n_s - 1)` at local index `g - c_src*keep_s` — the
+    inverse of the `gather_interior` de-duplication.  This reproduces
+    exactly what the flat `_redistribute` materializes globally, one
+    O(local) block at a time: interior bit-exact, halos as an `update_halo`
+    on globally-consistent data would give (periodic wrap included), and
+    open-boundary user-owned halo planes preserved (the edge blocks' outer
+    planes ARE de-duplicated global cells)."""
+    nds = len(params)
+    maps = []
+    for d, p in enumerate(params):
+        g = coords[d] * p["stride_b"] + np.arange(p["s_b"])
+        if p["periodic"]:
+            g %= p["size"]
+        c_src = np.minimum(g // p["keep_s"], p["n_s"] - 1)
+        maps.append((c_src, g - c_src * p["keep_s"]))
+    out = np.empty(tuple(tgt_local), dtype=dtype)
+    dims_s = man["dims"]
+    for combo in itertools.product(
+            *[np.unique(m[0]).tolist() for m in maps]):
+        pos = [np.nonzero(maps[d][0] == combo[d])[0] for d in range(nds)]
+        c3 = tuple(int(c) for c in combo) + (0,) * (NDIMS - nds)
+        rank_s = c3[0] + c3[1] * dims_s[0] + c3[2] * dims_s[0] * dims_s[1]
+        S = cache.get(rank_s)[name]
+        sel = tuple(maps[d][1][pos[d]] for d in range(nds))
+        out[np.ix_(*pos)] = S[np.ix_(*sel)]
+    return out
+
+
+def _load_sharded(path: pathlib.Path, grid, redistribute: bool) -> Dict:
+    """Directory branch of :func:`load_checkpoint`: every process restores
+    its own blocks shard-by-shard (same geometry: a 1:1 shard read per
+    block; different geometry: the elastic per-block assembly), through
+    `jax.make_array_from_callback` so each block lands directly on its
+    device — the global array is never materialized."""
+    import jax
+
+    from .fields import sharding_for, stacked_shape
+
+    man = _read_manifest_verified(path)
+    mine = _meta(grid)
+    same_geometry = {k: man.get(k) for k in mine} == mine
+    if not same_geometry and not redistribute:
+        diffs = {k: (man.get(k), mine[k]) for k in mine
+                 if man.get(k) != mine[k]}
+        raise GridError(
+            f"load_checkpoint: grid geometry mismatch {diffs} "
+            f"(checkpoint vs current).  Pass redistribute=True to re-tile "
+            f"the sharded generation onto the current decomposition "
+            f"(elastic restore).")
+    if not same_geometry and list(man["periods"]) != mine["periods"]:
+        raise GridError(
+            f"load_checkpoint(redistribute=True): periodicity mismatch "
+            f"{man['periods']} vs {mine['periods']} — redistribution "
+            f"changes the decomposition, not the physics.")
+
+    # Size the LRU to the SOURCE shards this process's blocks touch: the
+    # load loop below is field-outer, so each field's callbacks sweep the
+    # same source ranks in the same order — a smaller cache would evict
+    # every shard right before its next-field reuse and re-read (and
+    # re-CRC) the whole set once per field.  On an elastic shrink restore
+    # each target block overlaps ~ceil(n_src/n_tgt) source shards, each
+    # ~n_tgt/n_src the target block's size, so the bound stays
+    # O(this process's blocks) in BYTES even when it exceeds the block
+    # count — never the global array.
+    nlocal = sum(1 for dev in grid.mesh.devices.flat
+                 if dev.process_index == jax.process_index())
+    n_src = max(1, len(man["shards"]))
+    per_block = -(-n_src // max(1, int(grid.nprocs)))   # ceil
+    cache = _ShardCache(path, man, limit=max(4, nlocal * per_block))
+    out = {}
+    for name in sorted(man["local_shapes"]):
+        src_local = [int(v) for v in man["local_shapes"][name]]
+        nd = len(src_local)
+        nds = min(nd, NDIMS)
+        tgt_local = [grid.nxyz[d] + (src_local[d] - man["nxyz"][d])
+                     if d < NDIMS else src_local[d] for d in range(nd)]
+        if any(s < 1 for s in tgt_local):
+            raise GridError(
+                f"load_checkpoint: field '{name}' has local shape "
+                f"{tgt_local} on the current grid — the stagger recorded "
+                f"in {path} does not fit it.")
+        dtype = np.dtype(man["dtypes"][name])
+        gshape = tuple(stacked_shape(tgt_local, grid))
+        params = (None if same_geometry
+                  else _elastic_params(name, src_local, tgt_local, man, grid))
+
+        def cb(index, name=name, nds=nds, tgt_local=tgt_local,
+               params=params, dtype=dtype):
+            coords = tuple((index[d].start or 0) // tgt_local[d]
+                           for d in range(nds))
+            if params is None:
+                rank = grid.cart_rank(coords + (0,) * (NDIMS - nds))
+                return cache.get(rank)[name]
+            return _assemble_block(name, cache, man, params, coords,
+                                   tgt_local, dtype)
+
+        out[name] = jax.make_array_from_callback(
+            gshape, sharding_for(nd, grid), cb)
     return out

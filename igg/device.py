@@ -72,14 +72,41 @@ def _same_host_processes() -> List[int]:
 
     if jax.process_count() == 1:
         return [0]
-    from jax.experimental import multihost_utils
 
-    mine = _host_fingerprint()
     # (nprocs, 2): row p is process p's host fingerprint.
-    all_fp = np.asarray(multihost_utils.process_allgather(mine))
+    all_fp = _allgather_fingerprints(_host_fingerprint())
     me = int(jax.process_index())
     return [p for p in range(all_fp.shape[0])
             if (all_fp[p] == all_fp[me]).all()]
+
+
+def _allgather_fingerprints(mine: np.ndarray) -> np.ndarray:
+    """`(nprocs, k)` table of every process's host fingerprint, on every
+    process.  One compiled SPMD replication over the grid mesh — NOT
+    `multihost_utils.process_allgather` of a host value, which some
+    multi-controller backends (the multi-process CPU one included) do not
+    implement.  Each device contributes its owning process's fingerprint;
+    the replicated result is folded back per-process through the sharding's
+    device→index map.  Requires the grid (callers run after
+    `init_global_grid`, whose default mesh spans every process's devices).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .shared import AXIS_NAMES, global_grid, identity, replicating_jit
+
+    grid = global_grid()
+    ndev, k = grid.nprocs, int(mine.size)
+    sh = NamedSharding(grid.mesh, PartitionSpec(tuple(AXIS_NAMES)))
+    arr = jax.make_array_from_callback(
+        (ndev, k), sh, lambda idx: mine[None, :].astype(np.uint32))
+    rep = replicating_jit(
+        identity, NamedSharding(grid.mesh, PartitionSpec()))(arr)
+    rows = np.asarray(rep.addressable_shards[0].data)
+    fp = np.zeros((int(jax.process_count()), k), dtype=np.uint32)
+    for dev, idx in sh.devices_indices_map((ndev, k)).items():
+        fp[dev.process_index] = rows[idx[0].start or 0]
+    return fp
 
 
 def node_local_rank() -> int:

@@ -10,12 +10,16 @@ the device at those coords, the exact layout `cart_gather!` produces at
 
 from __future__ import annotations
 
+import functools
+import logging
 from typing import Optional
 
 import numpy as np
 
 from . import native, shared
 from .shared import GridError, NDIMS
+
+_log = logging.getLogger("igg.gather")
 
 
 def free_gather_buffer() -> None:
@@ -45,11 +49,11 @@ def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
         if A_global is not None:
             raise GridError("The input argument A_global must be None (or "
                             "omitted) on non-root processes.")
-        _fetch_global(A)  # non-root controllers still participate
+        _fetch_global(A, root=root)  # non-root: participate, O(local) staging
         return None
 
     local = grid.local_shape(A)
-    out = _fetch_global(A)
+    out = _fetch_global(A, root=root)
 
     if A_global is None:
         return out
@@ -63,53 +67,133 @@ def gather(A, A_global: Optional[np.ndarray] = None, *, root: int = 0):
     return None
 
 
-# Device->host fetches larger than this are pulled in leading-dim slabs so
+# Device->host fetches larger than this are pulled in largest-dim slabs so
 # the transfer staging never needs a second whole-array host buffer (the
 # role of the reference's granularity-rounded persistent gather buffer,
 # `/root/reference/src/gather.jl:43-49`, is played by bounded staging here).
 _CHUNK_BYTES = 1 << 28  # 256 MB
 
-# One-time memory-cliff warning flag: the multi-host allgather fallback
-# materializes the full global array on EVERY process (docs/multihost.md).
-_warned_allgather = False
+# One-shot debug-log guard for the multi-host slab path (the old one-time
+# allgather memory-cliff UserWarning is retired: the path below keeps
+# non-root host memory at O(slab), so there is no cliff left to warn about).
+_logged_multihost = False
 
 
-def _fetch_global(A, chunk_bytes: Optional[int] = None) -> np.ndarray:
-    """Device→host fetch of a (possibly multi-host) grid array.  On a
-    multi-host mesh, shards on non-addressable devices are exchanged over the
-    runtime first (the role MPI point-to-point plays in the reference's
-    `cart_gather!`, `/root/reference/src/gather.jl:52-58`).  Fully-addressable
-    arrays above `chunk_bytes` stream to the host in leading-dim slabs."""
+def _stream_axis(shape) -> Optional[int]:
+    """Axis a bounded slab fetch should stream over: the LARGEST dimension.
+    Streaming over dim 0 unconditionally silently degrades to a whole-array
+    second host buffer for `(1, ny, nz)`-shaped arrays (leading-singleton
+    slabs can't be split); any dim of size > 1 can.  None when every dim is
+    singleton (nothing to stream over)."""
+    if not shape or max(shape) <= 1:
+        return None
+    return int(np.argmax(shape))
+
+
+def _slabbed_get(A, limit: int) -> np.ndarray:
+    """Fully-addressable device→host fetch in bounded slabs over the largest
+    dimension, so transfer staging never holds a second whole-array buffer.
+    Below `limit` (or with no streamable dim) it is one plain fetch."""
     import jax
 
-    if getattr(A, "is_fully_addressable", True):
-        limit = _CHUNK_BYTES if chunk_bytes is None else chunk_bytes
-        nbytes = getattr(A, "nbytes", 0)
-        if nbytes > limit and getattr(A, "ndim", 0) >= 1 and A.shape[0] > 1:
-            rows = max(1, int(A.shape[0] * limit // nbytes))
-            out = np.empty(A.shape, dtype=A.dtype)
-            for i0 in range(0, A.shape[0], rows):
-                i1 = min(i0 + rows, A.shape[0])
-                out[i0:i1] = np.asarray(jax.device_get(A[i0:i1]))
-            return out
+    nbytes = int(getattr(A, "nbytes", 0))
+    axis = _stream_axis(getattr(A, "shape", ()))
+    if nbytes <= limit or axis is None:
         return np.asarray(jax.device_get(A))
-    global _warned_allgather
-    if not _warned_allgather:
-        import warnings
+    n = A.shape[axis]
+    rows = max(1, int(n * limit // nbytes))
+    out = np.empty(A.shape, dtype=A.dtype)
+    idx = [slice(None)] * A.ndim
+    for i0 in range(0, n, rows):
+        idx[axis] = slice(i0, min(i0 + rows, n))
+        out[tuple(idx)] = np.asarray(jax.device_get(A[tuple(idx)]))
+    return out
 
-        _warned_allgather = True
-        nbytes = int(getattr(A, "nbytes", 0))
-        warnings.warn(
-            f"igg.gather: multi-host arrays fall back to "
-            f"process_allgather(tiled=True), which materializes the FULL "
-            f"global array (~{nbytes / 2**20:.0f} MiB here) in host memory "
-            f"on EVERY process — not just the root.  This is the "
-            f"per-process memory cliff documented in docs/multihost.md; "
-            f"gather a sliced/subsampled field, or space out "
-            f"gather/checkpoint cadence, to stay under it.  (Warned once "
-            f"per process.)", stacklevel=3)
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(A, tiled=True))
+
+def _fetch_global(A, chunk_bytes: Optional[int] = None,
+                  root: int = 0) -> Optional[np.ndarray]:
+    """Device→host fetch of a (possibly multi-host) grid array; the full
+    host array is assembled ONLY on process `root` (`None` elsewhere — on a
+    single-controller run every caller is the root).  Fully-addressable
+    arrays above `chunk_bytes` stream to the host in largest-dim slabs.
+
+    On a multi-host mesh, shards on non-addressable devices are exchanged
+    over the runtime (the role MPI point-to-point plays in the reference's
+    `cart_gather!`, `/root/reference/src/gather.jl:52-58`) — but root-biased
+    and chunked, never through `process_allgather(tiled=True)`: one compiled
+    program replicates a bounded slab across the mesh per round, and only
+    the root process copies it to host and assembles.  Non-root processes
+    therefore stage O(slab) device memory and ~zero host memory — the
+    reference's non-root gather cost is one `Isend` of the local array
+    (`/root/reference/src/gather.jl:37-39`), and this is its memory contract
+    (docs/multihost.md), replacing the per-process allgather memory cliff."""
+    import jax
+
+    limit = _CHUNK_BYTES if chunk_bytes is None else chunk_bytes
+    if getattr(A, "is_fully_addressable", True):
+        return _slabbed_get(A, limit)
+    return _fetch_multihost(A, limit, root)
+
+
+@functools.lru_cache(maxsize=32)
+def _slab_jit(span: int, axis: int, out_sharding):
+    """The compiled slab-replication program of :func:`_fetch_multihost`,
+    cached on (span, axis, sharding) so repeated gathers/saves reuse it
+    instead of retracing per call."""
+    import jax
+    from jax import lax
+
+    def slab(x, i):
+        return lax.dynamic_slice_in_dim(x, i, span, axis)
+
+    return jax.jit(slab, out_shardings=out_sharding)
+
+
+def _fetch_multihost(A, limit: int, root: int) -> Optional[np.ndarray]:
+    """The multi-controller branch of :func:`_fetch_global` (see there).
+    Every process runs the same compiled slab-replication programs (they are
+    collectives); only `root` assembles."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    global _logged_multihost
+
+    grid = shared.global_grid()
+    is_root = int(jax.process_index()) == int(root)
+    repl = NamedSharding(grid.mesh, PartitionSpec())
+    if not _logged_multihost:
+        _logged_multihost = True
+        _log.debug(
+            "igg.gather: multi-host fetch takes the root-biased chunked "
+            "slab path (replicate <= %d MB per round, assemble on process "
+            "%d only; non-root host memory stays O(local)).",
+            limit >> 20, root)
+
+    ndim = int(getattr(A, "ndim", 0))
+    axis = _stream_axis(A.shape) if ndim else None
+    nbytes = int(getattr(A, "nbytes", 0))
+    if axis is None or nbytes <= limit:
+        rep = shared.replicating_jit(shared.identity, repl)(A)
+        if not is_root:
+            return None
+        return np.asarray(rep.addressable_shards[0].data)
+
+    n = A.shape[axis]
+    rows = max(1, int(n * limit // nbytes))
+    # One compiled program serves every round: `dynamic_slice` CLAMPS the
+    # start index, so the tail round re-reads a few already-copied rows
+    # instead of needing a second (differently-shaped) program.
+    slab = _slab_jit(min(rows, n), axis, repl)
+    out = np.empty(A.shape, dtype=A.dtype) if is_root else None
+    idx = [slice(None)] * ndim
+    for i0 in range(0, n, rows):
+        start = min(i0, n - min(rows, n))   # the clamp dynamic_slice applies
+        rep = slab(A, jnp.int32(start))
+        if is_root:
+            idx[axis] = slice(start, start + min(rows, n))
+            out[tuple(idx)] = np.asarray(rep.addressable_shards[0].data)
+    return out
 
 
 def gather_interior(A, *, root: int = 0):
@@ -126,10 +210,10 @@ def gather_interior(A, *, root: int = 0):
     shared.check_initialized()
     grid = shared.global_grid()
     if grid.me != root:
-        _fetch_global(A)
+        _fetch_global(A, root=root)   # participate; O(local) staging
         return None
 
-    stacked = _fetch_global(A)
+    stacked = _fetch_global(A, root=root)
     local = grid.local_shape(A)
 
     if A.ndim == 3:

@@ -21,11 +21,29 @@ examples don't reinvent it:
   lags injection by at most one watch window plus the pending depth).
 
 - **Checkpoint ring** — every `checkpoint_every` steps the state is written
-  as a generation file `{prefix}_<step>.npz` via :mod:`igg.checkpoint`
-  (atomic rename, CRC32 per-array manifest), keeping the newest `ring`
-  generations.  :func:`igg.latest_checkpoint` scans newest-first and skips
-  corrupt/truncated files, so a generation damaged by a crash or preemption
-  mid-write degrades the rollback depth by one instead of killing the run.
+  as a generation via :mod:`igg.checkpoint`, keeping the newest `ring`
+  generations.  By default (`sharded=True`) a generation is a sharded
+  DIRECTORY `{prefix}_<step>/`: every process writes only its own O(local)
+  blocks (`shard_<rank>.npz`, per-shard CRC32s) and process 0 seals it with
+  a manifest-written-last atomic commit — no process ever assembles the
+  global array, and a generation restores elastically onto a different
+  `dims`/device count (`igg.load_checkpoint(..., redistribute=True)`).
+  `sharded=False` keeps the legacy flat `{prefix}_<step>.npz` files.
+  Cadence generations are written ASYNCHRONOUSLY (`async_checkpoint=True`):
+  the state's device buffers are snapshotted by reference and handed to a
+  background writer thread (the :class:`igg.vis.BackgroundRenderer` shape,
+  bounded queue = bounded pinned snapshots) which polls `is_ready()` before
+  fetching, so the compiled hot loop never stalls on a device→host
+  transfer or a filesystem write; the writer is DRAINED before any
+  rollback scan, before the final preemption generation, and at end of
+  run.  A failed background write degrades the ring depth by one and emits
+  a 'checkpoint_failed' event instead of killing the run.  (Async holds
+  references to the snapshotted buffers until written — a `step_fn` that
+  DONATES its input buffers would invalidate them; use sync writes or
+  donate=False steps.)  :func:`igg.latest_checkpoint` scans newest-first
+  and skips corrupt/truncated/uncommitted generations, so one damaged by a
+  crash or preemption mid-write degrades the rollback depth by one instead
+  of killing the run.
 
 - **Rollback and retry** — when a probe reports a non-finite count (or the
   user's `divergence_fn` fires), the loop rolls back to the newest
@@ -97,7 +115,10 @@ def clear_preemption() -> None:
 @dataclasses.dataclass(frozen=True)
 class Event:
     """One observable incident of the loop (also passed to `on_event`):
-    `kind` is one of 'resume', 'checkpoint', 'nan_detected', 'divergence',
+    `kind` is one of 'resume', 'checkpoint' (detail `background: True` when
+    the generation was committed by the async writer), 'checkpoint_failed'
+    (a background write failed — one generation of ring depth lost),
+    'nan_detected', 'divergence',
     'rollback', 'preempt', or a chaos injector's 'chaos_*'; `step` is the
     step count the event is anchored to (for 'nan_detected' the PROBE step
     — injection happened inside that watch window); `detail` carries
@@ -158,6 +179,79 @@ def _is_ready(x) -> bool:
         return True
 
 
+class _AsyncCheckpointWriter:
+    """Background checkpoint writer — the :class:`igg.vis.BackgroundRenderer`
+    shape applied to the resilience ring's cadence generations.
+
+    `submit(step, fields, last_good)` snapshots the state dict BY REFERENCE
+    (no device→host transfer on the caller's thread) and enqueues it; the
+    worker thread first polls `is_ready()` on every buffer (the watchdog's
+    asynchronous-fetch pattern — fetching early would host-sync the device
+    stream the hot loop is still feeding), then runs the save function.
+    The bounded queue (`maxsize`) is the pinned-snapshot bound: at most
+    `maxsize` generations' device buffers are kept alive awaiting write,
+    and a submit beyond it backpressures instead of accumulating memory.
+
+    Completions and failures are handed back on the CALLER's thread:
+    :meth:`poll` (non-blocking, per loop iteration) and :meth:`drain`
+    (blocking — the synchronization point before any rollback scan, the
+    final preemption generation, and end of run) both return
+    `([(step, path)], [(step, error)])` — failures carry the step of the
+    generation that failed to write (not whatever step the caller happens
+    to be at when it polls), so the 'checkpoint_failed' event names the
+    actual lost ring slot.  A failed write surfaces as an error — one
+    generation of ring depth lost — never as an exception on the hot
+    loop.  The save function must not involve device collectives
+    (:func:`igg.save_checkpoint_sharded` is filesystem-coordinated, so it
+    qualifies)."""
+
+    def __init__(self, save_fn, *, maxsize: int = 2):
+        from .vis import BackgroundRenderer
+
+        self._save_fn = save_fn
+        self._done: deque = deque()    # (step, path), appended by the worker
+        self._failed: deque = deque()  # (step, exception), ditto
+        self._r = BackgroundRenderer(self._consume, maxsize=maxsize,
+                                     name="igg-ckpt-writer")
+
+    def _consume(self, batch) -> None:
+        import time
+
+        step, fields, last_good = batch
+        try:
+            while not all(_is_ready(a) for a in fields.values()):
+                time.sleep(0.002)
+            path = self._save_fn(step, fields, last_good)
+        except BaseException as e:
+            self._failed.append((step, e))
+            return
+        self._done.append((step, path))
+
+    def submit(self, step: int, fields: Dict, last_good: int) -> None:
+        self._r.submit((step, dict(fields), last_good))
+
+    def _results(self):
+        done, errs = [], []
+        while self._done:
+            done.append(self._done.popleft())
+        while self._failed:
+            errs.append(self._failed.popleft())
+        return done, errs
+
+    def poll(self):
+        """Completions/failures so far; never blocks."""
+        return self._results()
+
+    def drain(self):
+        """Block until every submitted generation is written (or failed),
+        then return the completions/failures."""
+        self._r.drain()
+        return self._results()
+
+    def close(self) -> None:
+        self._r.close()
+
+
 def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   *,
                   watch_every: int = 50,
@@ -172,6 +266,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   resume: bool = False,
                   steps_per_call: int = 1,
                   max_pending_probes: int = 4,
+                  sharded: bool = True,
+                  async_checkpoint: bool = True,
                   install_sigterm: bool = True,
                   on_event: Optional[Callable[[Event], None]] = None,
                   chaos=None) -> RunResult:
@@ -195,6 +291,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     - `checkpoint_every` > 0 enables the ring under `checkpoint_dir` (a
       generation is also written at entry so a rollback target always
       exists, and on preemption).  `ring` generations are kept.
+      `sharded=True` (default) writes generation DIRECTORIES
+      `{prefix}_<step>/` in the O(local) per-shard format of
+      :func:`igg.save_checkpoint_sharded`; `sharded=False` writes legacy
+      flat `{prefix}_<step>.npz` files.  `async_checkpoint=True` (default;
+      sharded only) hands cadence generations to a background writer
+      thread so the hot loop never stalls on the write — entry, rollback,
+      and preemption generations stay synchronous, and the writer is
+      drained before every rollback scan and before the final preemption
+      generation (module docstring for the full contract, including the
+      no-donation caveat).
     - On detection, the loop rolls back to the newest generation older
       than the failing probe that passes
       `igg.verify_checkpoint(check_finite=True)`, then calls
@@ -267,12 +373,23 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             on_event(ev)
         return ev
 
+    # Multi-controller: generation verification reads every shard once
+    # GLOBALLY (round-robin + AND-combined verdicts) instead of once per
+    # process; all processes reach these calls at the same iteration (see
+    # `deterministic_only` below), so the collective is safe.
+    dist_verify = jax.process_count() > 1
+
     steps_done = 0
     resumed_step = None
     if resume and cdir is not None:
-        found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True)
+        found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True,
+                                       distributed=dist_verify)
         if found is not None:
-            state = ckpt.load_checkpoint(found)
+            # redistribute=True makes the resume ELASTIC: a generation
+            # written under a different dims/device count is re-tiled onto
+            # the live decomposition (on a matching geometry it is the
+            # plain 1:1 restore — redistribute only engages on mismatch).
+            state = ckpt.load_checkpoint(found, redistribute=True)
             steps_done = resumed_step = ckpt.checkpoint_step(found) or 0
             if steps_done % steps_per_call != 0:
                 raise GridError(
@@ -289,6 +406,9 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     retries = 0
     preempted = False
     last_ckpt: Optional[pathlib.Path] = None
+    last_ckpt_step = -1
+    use_async = bool(async_checkpoint and sharded and checkpoint_every)
+    writer: Optional[_AsyncCheckpointWriter] = None   # created on first use
     # Steps whose on-disk generation is known to hold THIS run's state (a
     # leftover file from a previous run in the same directory does not
     # qualify); invalidated on rollback, where the replay may diverge from
@@ -311,25 +431,72 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         under a longer prefix is never pruned or rolled back into)."""
         return ckpt.list_generations(cdir, prefix) if cdir is not None else []
 
-    def _save_gen(step) -> None:
-        nonlocal last_ckpt
-        p = cdir / f"{prefix}_{step:09d}.npz"
-        ckpt.save_checkpoint(p, **state)
-        last_ckpt = p
+    def _gen_path(step) -> pathlib.Path:
+        """`{prefix}_<step>/` sharded generation directory, or the legacy
+        flat `{prefix}_<step>.npz` under `sharded=False`."""
+        return cdir / (f"{prefix}_{step:09d}" if sharded
+                       else f"{prefix}_{step:09d}.npz")
+
+    def _prune(good_until: int) -> None:
+        """Keep the newest `ring` generations plus the newest
+        health-established one (`good_until` — see `last_good`)."""
+        if jax.process_index() != 0:
+            return
+        gens = _generations()
+        keep = {s for s, _ in gens[-ring:]}
+        good = [s for s, _ in gens if s <= good_until]
+        if good:
+            keep.add(max(good))   # the healthy rollback target survives
+        for s, old in gens:
+            if s not in keep:
+                ckpt.remove_generation(old)
+
+    def _write_gen(step, fields, good_until) -> pathlib.Path:
+        """Write one generation and prune the ring — runs on the caller's
+        thread for sync generations and on the writer thread for async
+        ones (the sharded save is filesystem-coordinated: no device
+        collectives, so it is thread-safe)."""
+        p = _gen_path(step)
+        if sharded:
+            ckpt.save_checkpoint_sharded(p, **fields)
+        else:
+            ckpt.save_checkpoint(p, **fields)
+        _prune(good_until)
+        return p
+
+    def _record_gen(step, p, background=False) -> None:
+        nonlocal last_ckpt, last_ckpt_step
         synced.add(step)
-        if jax.process_index() == 0:
-            gens = _generations()
-            keep = {s for s, _ in gens[-ring:]}
-            good = [s for s, _ in gens if s <= last_good]
-            if good:
-                keep.add(max(good))   # the healthy rollback target survives
-            for s, old in gens:
-                if s not in keep:
-                    try:
-                        old.unlink()
-                    except OSError:
-                        pass
-        _emit("checkpoint", step, path=str(p))
+        if step >= last_ckpt_step:
+            last_ckpt, last_ckpt_step = p, step
+        detail = {"path": str(p)}
+        if background:
+            detail["background"] = True
+        _emit("checkpoint", step, **detail)
+
+    def _merge_writer(drain: bool = False) -> None:
+        """Collect background-write completions/failures onto the main
+        thread (bookkeeping + events).  `drain=True` blocks until the
+        writer queue is empty — the synchronization point before every
+        rollback scan, the final preemption generation, and end of run."""
+        if writer is None:
+            return
+        done, errs = writer.drain() if drain else writer.poll()
+        for step_w, p in done:
+            _record_gen(step_w, p, background=True)
+        for step_w, e in errs:
+            # One ring generation lost; the run continues.
+            _emit("checkpoint_failed", step_w,
+                  error=f"{type(e).__name__}: {e}")
+
+    def _save_gen(step, sync: bool = True) -> None:
+        nonlocal writer
+        if not sync and use_async:
+            if writer is None:
+                writer = _AsyncCheckpointWriter(_write_gen)
+            writer.submit(step, state, last_good)
+            return
+        _record_gen(step, _write_gen(step, state, last_good))
 
     # Multi-controller: every process must take the rollback branch at the
     # SAME iteration or their subsequent collective streams diverge.  The
@@ -362,7 +529,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
 
     def _rollback(ev: Event) -> None:
         nonlocal state, steps_done, retries, step_fn, final_probe_done, \
-            last_good, last_ckpt
+            last_good, last_ckpt, last_ckpt_step
         final_probe_done = False   # the replay's tail window re-probes
         retries += 1
         if retries > max_retries:
@@ -376,14 +543,29 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 f"checkpoint_dir is configured — nothing to roll back to.  "
                 f"Enable the ring (checkpoint_every/checkpoint_dir) for "
                 f"rollback-and-retry.")
-        target = None
-        for step_g, p in reversed(_generations()):
-            # A generation written between the blowup and its detection is
-            # structurally valid but poisoned; check_finite rejects it.
-            if step_g <= ev.step and ckpt.verify_checkpoint(
-                    p, check_finite=True):
-                target = (step_g, p)
-                break
+        # The generation scan must see every in-flight background write
+        # settled (committed or failed) — a half-staged directory is not a
+        # rollback candidate, and the newest healthy generation may still
+        # be in the writer queue.  Multi-controller: barrier after the
+        # drain, so no process scans while another's writer is still
+        # committing or pruning (every process reaches this rollback at
+        # the same iteration — see `deterministic_only`).
+        _merge_writer(drain=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("igg_rollback_scan")
+        # A generation written between the blowup and its detection is
+        # structurally valid but poisoned; check_finite rejects it.  The
+        # scan is the agreed-step probe protocol of `latest_checkpoint`:
+        # on a multi-controller run every process executes the same
+        # collectives in the same order even if their directory listings
+        # transiently diverge (NFS attribute caches).
+        found = ckpt.latest_checkpoint(
+            cdir, prefix, check_finite=True, max_step=ev.step,
+            distributed=jax.process_count() > 1)
+        target = ((ckpt.checkpoint_step(found), found)
+                  if found is not None else None)
         if target is None:
             raise ResilienceError(
                 f"run_resilient: {ev.kind} at step {ev.step} and no healthy "
@@ -396,6 +578,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         synced.add(steps_done)   # the loaded generation IS the state now
         last_good = steps_done   # finite-verified on load
         last_ckpt = target[1]    # result.checkpoint names the LIVE state
+        last_ckpt_step = steps_done
         # Generations NEWER than the target belong to the abandoned
         # attempt (finite or not, they are no longer this trajectory —
         # especially once recovery_policy changes the step): a later
@@ -403,10 +586,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         if jax.process_index() == 0:
             for s, p in _generations():
                 if s > steps_done:
-                    try:
-                        p.unlink()
-                    except OSError:
-                        pass
+                    ckpt.remove_generation(p)
         _emit("rollback", steps_done, from_step=ev.step,
               attempt=retries, path=str(target[1]))
         if recovery_policy is not None:
@@ -435,10 +615,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         # resume=True is the way to continue from an existing ring.
         if cdir is not None and not resume and jax.process_index() == 0:
             for _, old in _generations():
-                try:
-                    old.unlink()
-                except OSError:
-                    pass
+                ckpt.remove_generation(old)
         # Entry generation, so a rollback target exists from step 0 (a
         # resume that just loaded the generation at this exact step skips
         # the identical rewrite).
@@ -473,7 +650,11 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     _rollback(fail)
                     continue
                 if checkpoint_every and steps_done % checkpoint_every == 0:
-                    _save_gen(steps_done)
+                    # Cadence generations go to the background writer (the
+                    # hot loop's cost is a reference snapshot + queue put);
+                    # entry/rollback/preemption generations stay sync.
+                    _save_gen(steps_done, sync=False)
+                _merge_writer()   # cheap: a deque pop, no blocking
             if preempted:
                 break
             # End of the run: probe the tail window (if the final step is
@@ -486,6 +667,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     (steps_done, probe(*[state[n] for n in watch])))
             fail = _poll_probes(drain=True)
             if fail is None:
+                _merge_writer(drain=True)
                 break
             _rollback(fail)
 
@@ -500,14 +682,37 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             fail = _poll_probes(drain=True)
             if fail is not None:
                 _rollback(fail)
+            # Drain the background writer before the final generation: a
+            # cadence write still in flight at this step makes the rewrite
+            # redundant, and the final write must never race a background
+            # one (SIGTERM grace windows are exactly for this drain).
+            _merge_writer(drain=True)
             # Final atomic generation (skipped when a generation at this
             # step — the cadence write, or the one just rolled back to —
-            # already holds this state).
-            if cdir is not None and steps_done not in synced:
-                _save_gen(steps_done)
+            # already holds this state).  Multi-controller: the sharded
+            # save is a cross-process rendezvous, so the skip decision
+            # must be GLOBALLY consistent — `synced` can diverge (one
+            # process's background write failed, or its commit wait timed
+            # out after process 0 sealed), and a subset entering the
+            # rendezvous alone would hang out the SIGTERM grace window.
+            # AND-combine the per-process verdicts: if anyone is missing
+            # the generation, everyone rewrites it (overwriting a
+            # committed generation is safe — the save replaces it
+            # atomically).
+            if cdir is not None:
+                have = steps_done in synced
+                if jax.process_count() > 1:
+                    have = ckpt._combine_verdicts(have)
+                if not have:
+                    _save_gen(steps_done)
             _emit("preempt", steps_done,
                   path=str(last_ckpt) if last_ckpt else None)
     finally:
+        if writer is not None:
+            try:
+                _merge_writer(drain=True)
+            finally:
+                writer.close()
         if installed:
             signal.signal(signal.SIGTERM, old_handler)
         clear_preemption()

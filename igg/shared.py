@@ -16,6 +16,7 @@ reachable functionally through the returned/gettable :class:`GlobalGrid`.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -185,6 +186,28 @@ _grid_epoch: int = 0
 
 class GridError(RuntimeError):
     """Error raised for grid lifecycle / argument violations."""
+
+
+def identity(x):
+    """Module-level identity — a stable key for :func:`replicating_jit`
+    (a fresh per-call lambda would defeat the cache)."""
+    return x
+
+
+@functools.lru_cache(maxsize=16)
+def replicating_jit(fn, out_sharding):
+    """`jax.jit(fn, out_shardings=out_sharding)`, cached on the pair.
+
+    jit's trace cache is keyed on the wrapped callable, so building the
+    wrapper per call (`jax.jit(lambda x: x, ...)`) retraces and recompiles
+    the program every time — avoidable wall-clock on the small replication
+    programs the verify/gather/fingerprint paths run repeatedly.  `fn` must
+    be a module-level function and `out_sharding` hashable (NamedSharding
+    is); the bounded cache keeps dead meshes from accumulating across grid
+    re-inits."""
+    import jax
+
+    return jax.jit(fn, out_shardings=out_sharding)
 
 
 def grid_is_initialized() -> bool:

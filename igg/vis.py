@@ -30,9 +30,13 @@ class BackgroundRenderer:
     render there; exceptions are collected on :attr:`errors` and surfaced
     by :meth:`close` instead of killing the run mid-flight.  `maxsize`
     bounds the outstanding batches (submit blocks beyond it —
-    backpressure).  Use as a context manager or call :meth:`close`, which
-    drains the queue, joins the worker, and returns the error list; the
-    drain is intentionally part of the caller's wall-clock.
+    backpressure).  :meth:`drain` blocks until every batch submitted so
+    far has been consumed WITHOUT stopping the worker (the mid-run
+    synchronization point of the async checkpoint writer in
+    :mod:`igg.resilience`).  Use as a context manager or call
+    :meth:`close`, which drains the queue, joins the worker, and returns
+    the error list; the drain is intentionally part of the caller's
+    wall-clock.
     """
 
     def __init__(self, consume: Callable, *, maxsize: int = 3,
@@ -44,12 +48,15 @@ class BackgroundRenderer:
         def loop():
             while True:
                 batch = self._q.get()
+                try:
+                    if batch is not None:
+                        consume(batch)
+                except BaseException as e:   # surfaced at close()/drain()
+                    self._errors.append(e)
+                finally:
+                    self._q.task_done()
                 if batch is None:
                     return
-                try:
-                    consume(batch)
-                except BaseException as e:   # surfaced at close()
-                    self._errors.append(e)
 
         self._t = threading.Thread(target=loop, daemon=True, name=name)
         self._t.start()
@@ -67,6 +74,13 @@ class BackgroundRenderer:
         if self._closed:
             raise RuntimeError("BackgroundRenderer is closed.")
         self._q.put(batch)
+
+    def drain(self) -> List[BaseException]:
+        """Block until every batch submitted so far is consumed (the worker
+        stays alive for more submissions) and return the errors collected
+        so far."""
+        self._q.join()
+        return self.errors
 
     def close(self) -> List[BaseException]:
         """Drain remaining batches, stop the worker, and return any errors

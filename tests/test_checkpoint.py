@@ -167,6 +167,319 @@ def test_bfloat16_and_path_and_names(tmp_path):
     igg.finalize_global_grid()
 
 
+class TestSharded:
+    """The sharded generation format (igg-sharded-v1): O(local) save, the
+    manifest-written-last commit, and the ELASTIC restore path — a
+    generation written on the (2,2,2) 8-device mesh restores bit-exactly
+    (interiors AND halos, periodic and open dims) onto (1,2,4) and onto a
+    4-device mesh, without any process materializing the global array."""
+
+    @staticmethod
+    def _save(tmp_path, periods):
+        from helpers import encoded_field
+
+        igg.init_global_grid(6, 6, 6, quiet=True, **periods)   # (2,2,2)
+        T = igg.update_halo(encoded_field((6, 6, 6)))
+        Vx = igg.update_halo(encoded_field((7, 6, 6)))         # staggered
+        igg.save_checkpoint_sharded(tmp_path / "gen", T=T, Vx=Vx)
+        want = {
+            "interior": {k: np.asarray(igg.gather_interior(v))
+                         for k, v in (("T", T), ("Vx", Vx))},
+            "stacked": {k: np.asarray(v) for k, v in (("T", T), ("Vx", Vx))},
+        }
+        igg.finalize_global_grid()
+        return want
+
+    @staticmethod
+    def _target_locals(target_dims):
+        """Local sizes on `target_dims` matching the (2,2,2)/local-6 source
+        global domain: interior per dim = 2*(6-2) + 2*open and
+        n*(s-2) + 2*open == that, so s = 8/n + 2 independent of openness."""
+        return [2 * 4 // n + 2 for n in target_dims]
+
+    def test_roundtrip_same_geometry(self, tmp_path):
+        want = self._save(tmp_path, dict(periodx=1))
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+        assert igg.verify_checkpoint(tmp_path / "gen", check_finite=True)
+        out = igg.load_checkpoint(tmp_path / "gen")
+        for name in ("T", "Vx"):
+            np.testing.assert_array_equal(np.asarray(out[name]),
+                                          want["stacked"][name])
+        igg.update_halo(out["T"])    # restored fields are live
+
+    @pytest.mark.parametrize("periods", [
+        dict(periodx=1, periody=1, periodz=1), dict(periodx=1), {}])
+    @pytest.mark.parametrize("target", [(1, 2, 4), (4, 2, 1)])
+    def test_elastic_restore_bit_exact_including_halos(
+            self, tmp_path, periods, target):
+        from helpers import encoded_field
+
+        want = self._save(tmp_path, periods)
+        local = self._target_locals(target)
+        igg.init_global_grid(*local, dimx=target[0], dimy=target[1],
+                             dimz=target[2], quiet=True, **periods)
+        out = igg.load_checkpoint(tmp_path / "gen", redistribute=True)
+        for name, ls in (("T", tuple(local)),
+                         ("Vx", (local[0] + 1,) + tuple(local[1:]))):
+            got_i = np.asarray(igg.gather_interior(out[name]))
+            np.testing.assert_array_equal(got_i, want["interior"][name])
+            # The FULL stacked array — halo cells included — must equal the
+            # coordinate-encoded field built natively on the target grid:
+            # interiors bit-exact, periodic-wrap halos reconstructed, and
+            # open-boundary outer planes carrying the (user-owned) encoded
+            # values the source wrote.
+            exp = np.asarray(igg.update_halo(encoded_field(ls)))
+            np.testing.assert_array_equal(np.asarray(out[name]), exp)
+
+    def test_elastic_restore_onto_four_device_mesh(self, tmp_path):
+        """Device-count elasticity: a generation from the 8-device (2,2,2)
+        mesh restores onto a 4-device (2,2,1) mesh of the same host."""
+        import jax
+
+        from helpers import encoded_field
+
+        want = self._save(tmp_path, dict(periodx=1))
+        igg.init_global_grid(6, 6, 10, dimx=2, dimy=2, dimz=1, periodx=1,
+                             quiet=True, devices=jax.devices()[:4])
+        out = igg.load_checkpoint(tmp_path / "gen", redistribute=True)
+        np.testing.assert_array_equal(
+            np.asarray(igg.gather_interior(out["T"])), want["interior"]["T"])
+        exp = np.asarray(igg.update_halo(encoded_field((6, 6, 10))))
+        np.testing.assert_array_equal(np.asarray(out["T"]), exp)
+
+    def test_no_process_materializes_the_global_array(self, tmp_path,
+                                                      monkeypatch):
+        """Sentinel proof of the O(local) contract: the sharded save and
+        BOTH restore paths (1:1 and elastic) never touch the global-array
+        assembly (`gather._fetch_global`) or `process_allgather`."""
+        import importlib
+
+        from jax.experimental import multihost_utils
+
+        gather_mod = importlib.import_module("igg.gather")
+
+        def boom(*a, **k):
+            raise AssertionError("global-array path used by the sharded "
+                                 "checkpoint layer")
+
+        self._save(tmp_path, dict(periodx=1))
+        monkeypatch.setattr(gather_mod, "_fetch_global", boom)
+        monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+        state = igg.load_checkpoint(tmp_path / "gen")           # 1:1
+        igg.save_checkpoint_sharded(tmp_path / "gen2", **state)  # save
+        assert igg.verify_checkpoint(tmp_path / "gen2")
+        igg.finalize_global_grid()
+
+        igg.init_global_grid(10, 6, 4, dimx=1, dimy=2, dimz=4, periodx=1,
+                             quiet=True)
+        igg.load_checkpoint(tmp_path / "gen", redistribute=True)  # elastic
+
+    def test_uncommitted_generation_is_invalid(self, tmp_path):
+        """No manifest == no commit: the generation reads as invalid and
+        latest_checkpoint skips it, exactly like a truncated flat file."""
+        self._save(tmp_path, {})
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        (tmp_path / "gen" / "manifest.json").unlink()
+        assert not igg.verify_checkpoint(tmp_path / "gen")
+        with pytest.raises(igg.GridError, match="uncommitted"):
+            igg.load_checkpoint(tmp_path / "gen")
+
+    def test_staging_dir_is_not_a_generation(self, tmp_path):
+        """A `.tmp`-staged directory (writer died before the commit rename)
+        is invisible to the generation scan."""
+        from igg.checkpoint import list_generations
+
+        self._save(tmp_path, {})
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        gen = tmp_path / "ckpt_000000005"
+        (tmp_path / "gen").rename(gen)
+        assert [s for s, _ in list_generations(tmp_path)] == [5]
+        igg.chaos.corrupt_checkpoint(gen, "preempt_mid_write")
+        assert list_generations(tmp_path) == []
+        assert igg.latest_checkpoint(tmp_path) is None
+
+    def test_corrupt_and_missing_shards_detected(self, tmp_path):
+        self._save(tmp_path, {})
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        ok = tmp_path / "gen"
+        assert igg.verify_checkpoint(ok, check_finite=True)
+
+        import shutil
+        for mode, match in (("bitflip", "CRC32 mismatch"),
+                            ("truncate", "cannot read shard"),
+                            ("missing_shard", "cannot read shard")):
+            bad = tmp_path / f"bad_{mode}"
+            shutil.copytree(ok, bad)
+            igg.chaos.corrupt_checkpoint(bad, mode, shard=3)
+            assert not igg.verify_checkpoint(bad)
+            with pytest.raises(igg.GridError, match=match):
+                igg.load_checkpoint(bad)
+
+    def test_shard_swap_caught_by_summary_crc(self, tmp_path):
+        """Two shards swapped on disk: each is self-consistent (its own
+        CRCs pass), only the generation manifest's summary CRC ties shard
+        files to the write that produced them."""
+        import os
+
+        self._save(tmp_path, {})
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        gen = tmp_path / "gen"
+        a, b = gen / "shard_00000.npz", gen / "shard_00007.npz"
+        tmp = gen / "swap"
+        os.replace(a, tmp), os.replace(b, a), os.replace(tmp, b)
+        assert not igg.verify_checkpoint(gen)
+        with pytest.raises(igg.GridError, match="summary CRC32"):
+            igg.load_checkpoint(gen)
+
+    def test_verify_distributed_single_process_equals_plain(self, tmp_path):
+        self._save(tmp_path, {})
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        assert igg.verify_checkpoint_distributed(tmp_path / "gen",
+                                                 check_finite=True)
+        igg.chaos.corrupt_checkpoint(tmp_path / "gen", "bitflip")
+        assert not igg.verify_checkpoint_distributed(tmp_path / "gen")
+
+    def test_misuse(self, tmp_path):
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        T, _ = _mkfields()
+        with pytest.raises(igg.GridError, match="no fields"):
+            igg.save_checkpoint_sharded(tmp_path / "gen")
+        with pytest.raises(igg.GridError, match="reserved"):
+            igg.save_checkpoint_sharded(tmp_path / "gen",
+                                        **{"__igg_meta__": T})
+        with pytest.raises(igg.GridError, match="DIRECTORY"):
+            igg.save_checkpoint_sharded(tmp_path / "gen.npz", T=T)
+        with pytest.raises(igg.GridError, match="periodicity"):
+            self._mismatched_periods(tmp_path, T)
+
+    @staticmethod
+    def _mismatched_periods(tmp_path, T):
+        igg.save_checkpoint_sharded(tmp_path / "p0", T=T)
+        igg.finalize_global_grid()
+        igg.init_global_grid(10, 6, 6, dimx=1, dimy=1, dimz=1, periodx=1,
+                             quiet=True)
+        igg.load_checkpoint(tmp_path / "p0", redistribute=True)
+
+    def test_bf16_and_rank4_sharded(self, tmp_path):
+        """Extension dtypes (raw-byte encoded, dtype restored from the
+        manifest) and rank-4 component-stacked fields round-trip through
+        the sharded format, elastic restore included."""
+        import jax.numpy as jnp
+
+        from helpers import encoded_field
+
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)   # (2,2,2)
+        B = (igg.zeros((6, 6, 6), dtype=jnp.bfloat16)
+             + jnp.asarray(2.5, jnp.bfloat16))
+        U = igg.update_halo(encoded_field((6, 6, 6, 2)))
+        igg.save_checkpoint_sharded(tmp_path / "gen", B=B, U=U)
+        out = igg.load_checkpoint(tmp_path / "gen")
+        assert out["B"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(out["B"], np.float32), np.asarray(B, np.float32))
+        np.testing.assert_array_equal(np.asarray(out["U"]), np.asarray(U))
+        want_U = np.asarray(igg.gather_interior(U))
+        igg.finalize_global_grid()
+
+        igg.init_global_grid(10, 6, 6, dimx=1, dimy=2, dimz=2, periodx=1,
+                             quiet=True)
+        out = igg.load_checkpoint(tmp_path / "gen", redistribute=True)
+        assert out["B"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(igg.gather_interior(out["U"])), want_U)
+
+    def test_generation_overwrite_is_atomic_replace(self, tmp_path):
+        """Saving over an existing committed generation replaces it whole
+        (the staged-rename pattern), never merges shard sets."""
+        from helpers import encoded_field
+
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+        T = igg.update_halo(encoded_field((6, 6, 6)))
+        igg.save_checkpoint_sharded(tmp_path / "gen", T=T, Extra=T)
+        igg.save_checkpoint_sharded(tmp_path / "gen", T=T)
+        out = igg.load_checkpoint(tmp_path / "gen")
+        assert set(out) == {"T"}
+
+    def test_attempt_handshake_ignores_dead_attempt_leftovers(self,
+                                                              tmp_path):
+        """The multi-controller commit handshake at the filesystem level:
+        a peer entering a save while a DEAD attempt's staging dir (stale
+        hello, ack, and token file) still sits at the staging name must
+        never adopt the stale attempt — it returns only the token a live
+        process 0 issues AFTER clearing the leftovers, even though the
+        clear races the peer's polling."""
+        import threading
+        import time
+
+        from igg.checkpoint import (_ACK, _HELLO, _ack_hellos,
+                                    _peer_handshake)
+
+        staging = tmp_path / "ckpt_000000005.tmp"
+        staging.mkdir()
+        # Dead attempt's leftovers: the peer's own stale hello (answered!)
+        # plus another rank's — the worst case, an ack already matching a
+        # hello at the peer's OWN rank from the dead run.
+        (staging / _HELLO.format(1)).write_text("stalenonce")
+        (staging / _ACK.format(1)).write_text("stalenonce\nstaletoken")
+        (staging / _HELLO.format(2)).write_text("othernonce")
+        (staging / "attempt.token").write_text("staletoken")
+
+        got = {}
+
+        def peer():
+            got["token"] = _peer_handshake(staging, 1)
+
+        t = threading.Thread(target=peer)
+        t.start()
+        time.sleep(0.2)       # let the peer observe the stale staging dir
+        # Process 0 of the relaunch: clear the dead attempt, restage, and
+        # answer hellos from the shard-wait poll loop.
+        import shutil
+
+        shutil.rmtree(staging)
+        staging.mkdir()
+        deadline = time.monotonic() + 10.0
+        while t.is_alive() and time.monotonic() < deadline:
+            _ack_hellos(staging, "freshtoken")
+            time.sleep(0.02)
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert got["token"] == "freshtoken"
+        # The peer confirmed receipt (the third leg process 0 awaits
+        # before sealing, so even shard-less peers finish the handshake).
+        assert (staging / "done_00001").read_text() == (
+            staging / "hello_00001").read_text()
+
+    def test_same_step_flat_and_sharded_both_candidates(self, tmp_path):
+        """A step can hold BOTH artifacts — a sharded directory and a stale
+        flat file from a `sharded=False` run.  A corrupt one must not mask
+        the valid one: latest_checkpoint tries every generation, not one
+        per step."""
+        from helpers import encoded_field
+
+        igg.init_global_grid(6, 6, 6, quiet=True)
+        T = igg.update_halo(encoded_field((6, 6, 6)))
+        igg.save_checkpoint_sharded(tmp_path / "ckpt_000000007", T=T)
+        igg.save_checkpoint(tmp_path / "ckpt_000000007.npz", T=T)
+        igg.chaos.corrupt_checkpoint(tmp_path / "ckpt_000000007.npz",
+                                     "truncate")
+        found = igg.latest_checkpoint(tmp_path)
+        assert found is not None and found.is_dir()   # the valid sibling
+
+    def test_handshake_files_not_in_committed_generation(self, tmp_path):
+        """Hello/ack handshake files are save-time scaffolding; a committed
+        generation holds only shards and the manifest."""
+        import re
+
+        self._save(tmp_path, {})
+        names = {p.name for p in (tmp_path / "gen").iterdir()}
+        assert "manifest.json" in names
+        assert all(n == "manifest.json" or re.fullmatch(r"shard_\d+\.npz", n)
+                   for n in names)
+
+
 def test_rank4_roundtrip_and_redistribute(tmp_path):
     """Rank-4 component-stacked fields checkpoint and redistribute like
     rank-3 ones (trailing dims unsharded)."""

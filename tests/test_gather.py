@@ -89,7 +89,7 @@ class TestGather:
             igg.gather(A, np.zeros((8, 8, 8)), root=1)
 
     def test_chunked_fetch_matches_whole_fetch(self):
-        """Large-array gathers stream device->host in leading-dim slabs;
+        """Large-array gathers stream device->host in largest-dim slabs;
         forcing a tiny chunk size must reproduce the one-shot fetch
         bit-for-bit."""
         import importlib
@@ -103,6 +103,50 @@ class TestGather:
         np.testing.assert_array_equal(
             gather_mod._fetch_global(A, chunk_bytes=1024).reshape(whole.shape),
             whole.reshape(whole.shape))
+
+    def test_leading_singleton_streams_over_largest_dim(self, monkeypatch):
+        """A `(1, ny, nz)`-shaped array above the chunk limit must STILL
+        stream in bounded slabs (over its largest dim) instead of silently
+        falling back to a whole-array second host buffer — the old
+        leading-dim-only streaming skipped any array with `shape[0] <= 1`.
+        """
+        import importlib
+
+        import jax
+
+        gather_mod = importlib.import_module("igg.gather")
+
+        igg.init_global_grid(4, 4, 4, quiet=True)   # any live grid
+        A = jax.numpy.arange(1 * 64 * 32, dtype=jax.numpy.float64).reshape(
+            1, 64, 32)                              # 16 KiB
+        limit = 2048
+
+        fetched = []
+        real_get = jax.device_get
+
+        def tracking_get(x):
+            out = real_get(x)
+            fetched.append(int(np.asarray(out).nbytes))
+            return out
+
+        monkeypatch.setattr(jax, "device_get", tracking_get)
+        out = gather_mod._slabbed_get(A, limit)
+        monkeypatch.undo()
+
+        np.testing.assert_array_equal(out, np.asarray(A))
+        # Streamed: several bounded fetches, never a whole-array one.
+        assert len(fetched) > 1
+        assert max(fetched) <= limit
+
+    def test_stream_axis_picks_largest_dim(self):
+        from igg.gather import _stream_axis
+
+        assert _stream_axis((1, 64, 32)) == 1
+        assert _stream_axis((8, 4, 4)) == 0
+        assert _stream_axis((4, 4, 16)) == 2
+        assert _stream_axis((1, 1, 1)) is None     # nothing to stream over
+        assert _stream_axis(()) is None
+        assert _stream_axis((5,)) == 0
 
 
 class TestRank4:

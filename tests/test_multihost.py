@@ -71,6 +71,68 @@ igg.tic(); igg.toc()
 igg.finalize_global_grid()
 """
 
+# Worker for the O(local) contract (round 9): during a SHARDED save and a
+# root-biased gather, a non-root process must never materialize the global
+# array — `process_allgather` (the old full-global-on-every-process
+# fallback) is sentinel-blocked, and every device→host fetch is bounded by
+# one local block (the VERDICT item-4 done-criterion).
+_WORKER_OLOCAL = r"""
+import os, sys
+pid, nproc, port, outfile = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=nproc, process_id=pid)
+import numpy as np, igg
+import jax.numpy as jnp
+me, dims, nprocs, coords, mesh = igg.init_global_grid(
+    6, 6, 6, periodx=1, quiet=True)
+A = igg.zeros((6, 6, 6))
+X, Y, Z = igg.coord_fields(1.0, 1.0, 1.0, A)
+A = igg.update_halo(A + X * 10000 + Y * 100 + Z)
+
+import jax.experimental.multihost_utils as mhu
+def _allgather_sentinel(*a, **k):
+    raise AssertionError("process_allgather used on an O(local) path")
+real_allgather = mhu.process_allgather
+real_get = jax.device_get
+fetched = []
+def _tracking_get(x):
+    out = real_get(x)
+    try:
+        fetched.append(int(np.asarray(out).nbytes))
+    except TypeError:
+        pass
+    return out
+mhu.process_allgather = _allgather_sentinel
+jax.device_get = _tracking_get
+try:
+    ck = outfile + ".sharded"
+    igg.save_checkpoint_sharded(ck, A=A)
+    B = igg.load_checkpoint(ck)["A"]
+    assert bool(jnp.all(B == A)), "sharded multihost roundtrip mismatch"
+    out = igg.gather(A)            # root-biased chunked path, no allgather
+    if me == 0:
+        assert out is not None and out.shape == (12, 12, 12)
+        np.save(outfile, out)
+    else:
+        assert out is None
+finally:
+    mhu.process_allgather = real_allgather
+    jax.device_get = real_get
+# Bounded peak staging: no single fetch exceeded one (6,6,6) f64 block.
+local_nbytes = 6 * 6 * 6 * 8
+assert fetched, "sharded save fetched nothing?"
+assert max(fetched) <= local_nbytes, (max(fetched), local_nbytes)
+# Distributed verify: each process reads a round-robin shard subset; the
+# verdict combine is one SPMD min-reduce over the mesh (no allgather of
+# host values).
+assert igg.verify_checkpoint_distributed(ck, check_finite=True)
+igg.finalize_global_grid()
+"""
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -78,21 +140,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# The multi-process CPU runtime needs cross-process computation support in
+# jaxlib (newer CPU backends ship Gloo collectives; some builds do not).
+# When absent, EVERY cross-process program fails with this message — the
+# subprocess tests then skip instead of reporting a library bug.
+_NO_MULTIPROC = "Multiprocess computations aren't implemented"
 
 
-@pytest.mark.slow
-def test_two_controller_processes_match_single_controller(tmp_path):
+def _spawn_workers(tmp_path, worker_src, out, nproc=2):
+    """Launch `nproc` controller subprocesses of `worker_src`; returns
+    their logs.  Skips (not fails) when the backend cannot run
+    cross-process computations at all."""
     port = str(_free_port())
-    out = tmp_path / "gathered.npy"
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(worker_src)
     env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the TPU plugin out
     procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(p), "2", port, str(out)],
+        [sys.executable, str(worker), str(p), str(nproc), port, str(out)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for p in range(2)]
+        for p in range(nproc)]
     try:
         logs = [p.communicate(timeout=240)[0].decode() for p in procs]
     except subprocess.TimeoutExpired:
@@ -105,8 +173,19 @@ def test_two_controller_processes_match_single_controller(tmp_path):
             partial.append((rest or b"").decode())
         pytest.fail("multihost workers timed out; partial output:\n"
                     + "\n---\n".join(partial))
+    if any(_NO_MULTIPROC in log for log in logs):
+        pytest.skip("this jaxlib's CPU backend has no cross-process "
+                    "computation support; run the multihost subprocess "
+                    "tests on a backend with cross-process collectives")
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log}"
+    return logs
+
+
+@pytest.mark.slow
+def test_two_controller_processes_match_single_controller(tmp_path):
+    out = tmp_path / "gathered.npy"
+    _spawn_workers(tmp_path, _WORKER, out)
 
     # Single-controller oracle on the same 8-device global grid.
     igg.init_global_grid(6, 6, 6, periodx=1, periodz=1, quiet=True)
@@ -118,3 +197,23 @@ def test_two_controller_processes_match_single_controller(tmp_path):
 
     got = np.load(out)
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_sharded_save_and_gather_keep_nonroot_o_local(tmp_path):
+    """Two controller processes: sharded checkpoint save/load/verify and a
+    root-biased gather with `process_allgather` sentinel-blocked and every
+    device→host fetch bounded by one local block (assertions live in the
+    worker) — non-root processes never materialize the global array.  The
+    root's gathered array still matches the single-controller oracle."""
+    out = tmp_path / "gathered.npy"
+    _spawn_workers(tmp_path, _WORKER_OLOCAL, out)
+
+    igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+    A = igg.zeros((6, 6, 6))
+    X, Y, Z = igg.coord_fields(1.0, 1.0, 1.0, A)
+    A = igg.update_halo(A + X * 10000 + Y * 100 + Z)
+    want = igg.gather(A)
+    igg.finalize_global_grid()
+
+    np.testing.assert_array_equal(np.load(out), want)

@@ -118,9 +118,12 @@ def test_rollback_and_retry_bit_exact(tmp_path):
     kinds = [e.kind for e in res.events]
     assert "rollback" in kinds
     np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
-    # Ring pruned to `ring` newest generations.
-    gens = sorted(tmp_path.glob("ckpt_*.npz"))
+    # Ring pruned to `ring` newest generations — sharded DIRECTORIES now
+    # (the run_resilient default), not flat .npz files.
+    from igg.checkpoint import list_generations
+    gens = list_generations(tmp_path)
     assert len(gens) == 3
+    assert all(p.is_dir() for _, p in gens)
 
 
 def test_fresh_run_clears_leftover_generations(tmp_path):
@@ -161,7 +164,7 @@ def test_ring_ignores_sibling_prefix(tmp_path):
     igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
                       checkpoint_dir=tmp_path, checkpoint_every=5, ring=2)
     assert foreign.exists()      # ring=2 pruning never touched it
-    assert igg.latest_checkpoint(tmp_path).name == "ckpt_000000020.npz"
+    assert igg.latest_checkpoint(tmp_path).name == "ckpt_000000020"
 
 
 def test_rollback_skips_poisoned_generation(tmp_path):
@@ -234,11 +237,11 @@ def test_latest_checkpoint_falls_back_past_truncation(tmp_path):
     igg.run_resilient(step_fn, _init_state(), 15, watch_every=5,
                       checkpoint_dir=tmp_path, checkpoint_every=5, ring=3)
     newest = igg.latest_checkpoint(tmp_path)
-    assert newest is not None and newest.name.endswith("15.npz")
+    assert newest is not None and igg.checkpoint.checkpoint_step(newest) == 15
 
-    igg.chaos.corrupt_checkpoint(newest, "truncate")
+    igg.chaos.corrupt_checkpoint(newest, "truncate")   # truncates shard 0
     fallback = igg.latest_checkpoint(tmp_path)
-    assert fallback is not None and fallback.name.endswith("10.npz")
+    assert fallback is not None and igg.checkpoint.checkpoint_step(fallback) == 10
     # The truncated newest raises a GridError NAMING the path (not a raw
     # zipfile.BadZipFile), the satellite contract.
     with pytest.raises(igg.GridError, match=newest.name):
@@ -332,7 +335,7 @@ def test_rollback_discards_newer_abandoned_generations(tmp_path):
     steps = [s for s, _ in list_generations(tmp_path)]
     assert max(steps) == 12
     assert igg.latest_checkpoint(tmp_path, check_finite=True).name \
-        == "ckpt_000000012.npz"
+        == "ckpt_000000012"
 
 
 # ---------------------------------------------------------------------------
@@ -527,6 +530,152 @@ def test_steps_per_call_multi_step_dispatch(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Sharded generations (round 9): distributed failure shapes, async writes,
+# elastic resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip", "missing_shard",
+                                  "partial_commit", "preempt_mid_write"])
+def test_sharded_fault_skipped_and_recovered_bit_exact(tmp_path, mode):
+    """Every distributed failure shape of the sharded format — a corrupt
+    shard (truncated or bit-flipped), a missing shard, a manifest-absent
+    partial commit, and a writer preempted before the commit rename — makes
+    `run_resilient` skip the damaged newest generation and recover
+    bit-exactly from the previous one."""
+    _grid()
+    step_fn = _make_step()
+    ref = _clean_run(step_fn, _init_state(), 20)
+
+    igg.run_resilient(step_fn, _init_state(), 10, watch_every=5,
+                      checkpoint_dir=tmp_path, checkpoint_every=5, ring=3)
+    newest = igg.latest_checkpoint(tmp_path)
+    assert igg.checkpoint.checkpoint_step(newest) == 10
+    igg.chaos.corrupt_checkpoint(newest, mode)
+    assert igg.latest_checkpoint(tmp_path) != newest
+
+    res = igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            ring=3, resume=True)
+    assert res.events[0].kind == "resume" and res.events[0].step == 5
+    assert res.steps_done == 20
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_async_checkpoints_commit_in_background(tmp_path):
+    """The default ring (sharded + async): cadence generations are written
+    by the background writer (events carry `background: True`), drained at
+    end of run, and the newest one holds the final state bit-exactly."""
+    _grid()
+    step_fn = _make_step()
+    res = igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            ring=3)
+    cks = [e for e in res.events if e.kind == "checkpoint"]
+    assert any(e.detail.get("background") for e in cks)       # cadence gens
+    assert not cks[0].detail.get("background")                # entry gen sync
+    assert not any(e.kind == "checkpoint_failed" for e in res.events)
+    newest = igg.latest_checkpoint(tmp_path, check_finite=True)
+    assert igg.checkpoint.checkpoint_step(newest) == 20
+    out = igg.load_checkpoint(newest)
+    np.testing.assert_array_equal(np.asarray(out["T"]),
+                                  np.asarray(res.state["T"]))
+
+
+def test_sync_and_flat_checkpoint_modes(tmp_path):
+    """`async_checkpoint=False` writes every generation synchronously;
+    `sharded=False` keeps the legacy flat `.npz` ring."""
+    _grid()
+    step_fn = _make_step()
+    res = igg.run_resilient(step_fn, _init_state(), 10, watch_every=5,
+                            checkpoint_dir=tmp_path / "sync",
+                            checkpoint_every=5, async_checkpoint=False)
+    assert not any(e.detail.get("background") for e in res.events
+                   if e.kind == "checkpoint")
+    assert igg.latest_checkpoint(tmp_path / "sync").is_dir()
+
+    res = igg.run_resilient(step_fn, _init_state(), 10, watch_every=5,
+                            checkpoint_dir=tmp_path / "flat",
+                            checkpoint_every=5, sharded=False)
+    newest = igg.latest_checkpoint(tmp_path / "flat")
+    assert newest.name == "ckpt_000000010.npz" and newest.is_file()
+    np.testing.assert_array_equal(
+        np.asarray(igg.load_checkpoint(newest)["T"]),
+        np.asarray(res.state["T"]))
+
+
+def test_failed_background_write_degrades_ring_not_run(tmp_path, monkeypatch):
+    """One background write failing (disk full, lost host) costs one ring
+    generation and emits 'checkpoint_failed'; the run itself completes and
+    the other generations commit."""
+    from igg import checkpoint as ckpt
+
+    _grid()
+    step_fn = _make_step()
+    real = ckpt.save_checkpoint_sharded
+    calls = {"n": 0}
+
+    def flaky(path, /, **fields):
+        calls["n"] += 1
+        if calls["n"] == 2:                  # first CADENCE write (entry
+            raise OSError("disk full")       # generation is call #1, sync)
+        return real(path, **fields)
+
+    monkeypatch.setattr(ckpt, "save_checkpoint_sharded", flaky)
+    res = igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            ring=10)
+    fails = [e for e in res.events if e.kind == "checkpoint_failed"]
+    assert len(fails) == 1 and "disk full" in fails[0].detail["error"]
+    assert fails[0].step == 5      # the LOST generation's step, not the
+    assert res.steps_done == 20    # step the failure was collected at
+    from igg.checkpoint import list_generations
+    steps = [s for s, _ in list_generations(tmp_path)]
+    assert 5 not in steps                    # the lost generation
+    assert {0, 10, 15, 20} <= set(steps)     # the rest committed
+
+
+def test_elastic_resume_onto_different_topology(tmp_path):
+    """A preempted run's sharded generation, written on the (2,2,2)
+    8-device mesh, resumes on a (1,2,4) decomposition via
+    `run_resilient(resume=True)` — re-tiled restore, then the remaining
+    steps — and finishes bit-identical to an uninterrupted (2,2,2) run."""
+    _grid()                                   # (2,2,2), periodic all
+    step_fn = _make_step()
+    state0 = _init_state()
+    ref = np.asarray(igg.gather_interior(
+        _clean_run_state(step_fn, dict(state0), 20)["T"]))
+
+    plan = igg.chaos.ChaosPlan(preempt_at=10)
+    res = igg.run_resilient(step_fn, state0, 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            chaos=plan)
+    assert res.preempted and res.steps_done == 10
+    igg.finalize_global_grid()
+
+    # Same global domain (periodic: 2*(6-2) = 8 per dim) on (1,2,4):
+    # locals 8/n + 2.
+    igg.init_global_grid(10, 6, 4, dimx=1, dimy=2, dimz=4,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    step_fn2 = _make_step()
+    rng = np.random.default_rng(0)
+    dummy = {"T": igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (10, 6, 4))}
+    res2 = igg.run_resilient(step_fn2, dummy, 20, watch_every=5,
+                             checkpoint_dir=tmp_path, checkpoint_every=5,
+                             resume=True)
+    assert res2.events[0].kind == "resume" and res2.events[0].step == 10
+    assert res2.steps_done == 20
+    np.testing.assert_array_equal(
+        np.asarray(igg.gather_interior(res2.state["T"])), ref)
+
+
+def _clean_run_state(step_fn, state, n):
+    for _ in range(n):
+        state = step_fn(state)
+    return state
+
+
+# ---------------------------------------------------------------------------
 # Satellites: distributed-init retry, stale tmp sweep
 # ---------------------------------------------------------------------------
 
@@ -615,3 +764,45 @@ def test_stale_tmp_swept_with_one_time_warning(tmp_path, monkeypatch):
         warnings.simplefilter("error")
         igg.save_checkpoint(tmp_path / "b.npz", **state)
     assert not (tmp_path / "old2.npz.tmp").exists()
+
+
+def test_stale_staging_directory_swept(tmp_path, monkeypatch):
+    """The sweep extends to orphaned `*.tmp` generation DIRECTORIES (a
+    sharded writer crashed mid-commit): same age guard, same one-time
+    warning — and a `.tmp` directory that is NOT our staging shape is
+    never deleted from a shared checkpoint dir."""
+    from igg import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt, "_warned_stale_tmp", False)
+    _grid()
+    state = _init_state()
+
+    def _age(path):
+        old = os.path.getmtime(path) - ckpt._STALE_TMP_AGE_S - 60
+        os.utime(path, (old, old))
+        return path
+
+    # A crashed sharded writer's staging dir: shard files (one still under
+    # its own .tmp name), manifest never sealed — aged past the guard.
+    stale = tmp_path / "ckpt_000000007.tmp"
+    stale.mkdir()
+    (stale / "shard_00000.npz").write_bytes(b"partial shard")
+    (stale / "shard_00001.npz.tmp").write_bytes(b"mid-write shard")
+    (stale / "manifest.json.tmp").write_bytes(b"{")
+    _age(stale)
+    # A foreign .tmp directory (not our staging shape): old, but kept.
+    foreign = tmp_path / "other_tool.tmp"
+    foreign.mkdir()
+    (foreign / "notes.txt").write_text("not igg's to delete")
+    _age(foreign)
+    # A YOUNG staging dir may belong to a live concurrent writer: kept.
+    fresh = tmp_path / "ckpt_000000009.tmp"
+    fresh.mkdir()
+    (fresh / "shard_00002.npz").write_bytes(b"live")
+
+    with pytest.warns(UserWarning, match="stale .tmp"):
+        igg.save_checkpoint_sharded(tmp_path / "a", **state)
+    assert not stale.exists()
+    assert foreign.exists() and (foreign / "notes.txt").exists()
+    assert fresh.exists()
+    assert igg.verify_checkpoint(tmp_path / "a")

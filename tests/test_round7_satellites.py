@@ -97,35 +97,41 @@ def test_stacked64_path_engages(monkeypatch):
     igg.finalize_global_grid()
 
 
-def test_gather_allgather_warning(monkeypatch):
-    """The multi-host allgather fallback warns ONCE with the per-process
-    bytes (the docs/multihost.md memory cliff)."""
+def test_gather_memory_cliff_warning_retired():
+    """Round 9 retired the one-time allgather memory-cliff UserWarning:
+    the multi-host fetch is now the root-biased chunked slab path (no
+    `process_allgather` anywhere in igg.gather, non-root host memory
+    O(slab)), with a one-shot DEBUG log in its place — and a plain gather
+    emits no warning at all."""
     import importlib
-
-    from jax.experimental import multihost_utils
+    import inspect
 
     gather = importlib.import_module("igg.gather")  # igg.gather the
-    # attribute is the function; we need the module for the seam flag
+    # attribute is the function; we need the module
 
-    class Stub:
-        is_fully_addressable = False
-        nbytes = 64 << 20
-        ndim = 3
-        shape = (128, 128, 128)
+    assert not hasattr(gather, "_warned_allgather")      # flag retired
+    # the allgather fallback is gone: nothing in igg.gather even imports
+    # the multihost_utils module it lived in (docstrings may MENTION it)
+    assert "multihost_utils" not in inspect.getsource(gather)
+    assert hasattr(gather, "_fetch_multihost")           # the replacement
+    assert hasattr(gather, "_logged_multihost")          # debug-log guard
 
-    monkeypatch.setattr(multihost_utils, "process_allgather",
-                        lambda A, tiled=True: np.zeros((2, 2)))
-    monkeypatch.setattr(gather, "_warned_allgather", False)
-    with pytest.warns(UserWarning, match="EVERY process"):
-        gather._fetch_global(Stub())
+    igg.init_global_grid(6, 6, 6, quiet=True)
     with warnings.catch_warnings():
-        warnings.simplefilter("error")      # second call: silent
-        gather._fetch_global(Stub())
+        warnings.simplefilter("error")
+        out = igg.gather(igg.zeros((6, 6, 6)))
+    assert out.shape == (12, 12, 12)
+    igg.finalize_global_grid()
 
 
-def test_checkpoint_cliff_warning(tmp_path, monkeypatch):
-    """save_checkpoint warns once on multi-controller runs with the
-    total simultaneously-materialized bytes."""
+def test_checkpoint_flat_fallback_logs_debug_not_warning(tmp_path,
+                                                         monkeypatch,
+                                                         caplog):
+    """Round 9: the multi-controller flat-.npz save no longer warns about
+    a memory cliff (root-biased fetch keeps non-root memory O(local)); it
+    logs ONE debug line naming the sharded alternative."""
+    import logging
+
     import jax
     from jax.experimental import multihost_utils
 
@@ -136,10 +142,17 @@ def test_checkpoint_cliff_warning(tmp_path, monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(multihost_utils, "sync_global_devices",
                         lambda tag: None)
-    monkeypatch.setattr(checkpoint, "_warned_ckpt_cliff", False)
-    with pytest.warns(UserWarning, match="memory cliff"):
-        igg.save_checkpoint(tmp_path / "c.npz", T=A)
+    assert not hasattr(checkpoint, "_warned_ckpt_cliff")   # flag retired
+    monkeypatch.setattr(checkpoint, "_logged_flat_fallback", False)
     with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        igg.save_checkpoint(tmp_path / "c2.npz", T=A)
+        warnings.simplefilter("error")          # no UserWarning anymore
+        with caplog.at_level(logging.DEBUG, logger="igg.checkpoint"):
+            igg.save_checkpoint(tmp_path / "c.npz", T=A)
+    assert any("save_checkpoint_sharded" in r.getMessage()
+               for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.DEBUG, logger="igg.checkpoint"):
+        igg.save_checkpoint(tmp_path / "c2.npz", T=A)   # one-shot: silent
+    assert not [r for r in caplog.records
+                if "save_checkpoint_sharded" in r.getMessage()]
     igg.finalize_global_grid()
