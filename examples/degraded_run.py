@@ -1,0 +1,124 @@
+"""The verified tier-degradation ladder end to end: compile failure →
+quarantine → fallback, corrupt kernel → verify refusal, corrupt kernel →
+`run_resilient` tier demotion — each run COMPLETES bit-exact to the
+pure-XLA composition (the degradation chaos smoke `ci.sh` drives).
+
+What `igg.degrade` gives a production run, demonstrated with the
+deterministic fault injectors of `igg.chaos` (the same harness
+`tests/test_degrade.py` drives):
+
+1. a clean reference run of the diffusion model on the pure-XLA
+   composition truth path;
+2. a run whose fused-kernel tier fails to compile
+   (`kernel_compile_fail`, the toolchain-regression shape): the first
+   dispatch captures the error, quarantines the tier — visible in
+   `igg.degrade.status()` — and completes on the XLA rung, bit-exact;
+3. a run whose fused-kernel tier is miscompiled (`kernel_corrupt`) under
+   `verify="first_use"`: the one-time numeric check against the truth
+   rung refuses the tier BEFORE it serves traffic — bit-exact again,
+   a wrong answer is never served;
+4. the same miscompiled kernel inside `igg.run_resilient` with NO
+   verify and NO recovery_policy: the watchdog detects the NaN, the
+   rollback replays, the recurrence at the same step triggers the
+   tier-demotion rung (`tier_degraded` event), and the run completes
+   bit-exact on the demoted ladder.
+
+Run on TPU or on a virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/degraded_run.py
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg.models import diffusion3d as d3
+
+TIER = "diffusion3d.mosaic"
+
+
+def main(nx=8, nt=40):
+    igg.init_global_grid(nx, nx, 128, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    me = igg.get_global_grid().me
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+    interpret = not igg.halo._is_tpu(igg.get_global_grid())
+
+    def run(step, n=nt):
+        T = T0 + 0
+        for _ in range(n):
+            T = step(T, Cp)
+        return np.asarray(T)
+
+    def say(msg):
+        if me == 0:
+            print(msg)
+
+    # ---- 1. clean reference: the pure-XLA composition truth ----
+    ref = run(d3.make_step(params, use_pallas=False, donate=False))
+
+    # ---- 2. compile failure -> quarantine -> bit-exact fallback ----
+    say(f"chaos: Mosaic compile failure on {TIER}")
+    with igg.chaos.kernel_compile_fail(TIER, "chaos: toolchain regression"):
+        step = d3.make_step(params, donate=False,
+                            pallas_interpret=interpret)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = run(step)
+    q = igg.degrade.status()[TIER]
+    say(f"  quarantined: {q.tier} (rung {q.rung}, {q.reason})")
+    assert q.reason == "compile_failed"
+    assert np.array_equal(out, ref), "fallback must be bit-exact"
+    say("  run completed bit-exact on the XLA rung")
+    igg.degrade.reset()
+
+    # ---- 3. corrupt kernel + verify="first_use" -> never serves ----
+    say(f"chaos: corrupt kernel output on {TIER}, verify='first_use'")
+    with igg.chaos.kernel_corrupt(TIER, magnitude=1e3):
+        step = d3.make_step(params, donate=False, verify="first_use",
+                            pallas_interpret=interpret)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = run(step)
+    q = igg.degrade.status()[TIER]
+    say(f"  quarantined: {q.tier} ({q.reason})")
+    assert q.reason == "verify_mismatch"
+    assert np.array_equal(out, ref), "a wrong answer must never be served"
+    say("  mismatch caught before serving; run bit-exact on the XLA rung")
+    igg.degrade.reset()
+
+    # ---- 4. corrupt kernel under run_resilient -> tier demotion ----
+    ckdir = os.path.join(tempfile.gettempdir(), "igg_degraded_run")
+    shutil.rmtree(ckdir, ignore_errors=True)
+    say(f"chaos: NaN-corrupt kernel on {TIER} under run_resilient "
+        f"(no verify, no recovery_policy)")
+    step = d3.make_step(params, donate=False, pallas_interpret=interpret)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with igg.chaos.kernel_corrupt(TIER):
+            res = igg.run_resilient(
+                lambda s: {"T": step(s["T"], Cp)}, {"T": T0 + 0}, nt,
+                watch_every=10, checkpoint_dir=ckdir, checkpoint_every=10,
+                async_checkpoint=False)
+    deg = [e for e in res.events if e.kind == "tier_degraded"]
+    assert deg and deg[0].detail["tier"] == TIER
+    assert res.steps_done == nt and res.retries <= 3
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+    say(f"  tier_degraded at step {deg[0].step}; retries={res.retries}; "
+        f"run completed bit-exact on the demoted ladder")
+
+    shutil.rmtree(ckdir, ignore_errors=True)
+    say("degraded_run: OK")
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

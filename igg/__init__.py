@@ -22,9 +22,12 @@ plus TPU-native extensions: field constructors (`zeros`, `ones`, `full`),
 coordinate fields (`x_g_field`, ..., `coord_fields`), whole-step SPMD
 compilation (`sharded`, `update_halo_local`, `local_coords`),
 `gather_interior`, checkpointing (`save_checkpoint`, `load_checkpoint`,
-`latest_checkpoint`, `verify_checkpoint`), and the resilient run loop
+`latest_checkpoint`, `verify_checkpoint`), the resilient run loop
 (`run_resilient` — device-side NaN watchdog, checkpoint ring with
-rollback-and-retry, preemption handling; fault injectors in `igg.chaos`).
+rollback-and-retry, preemption handling; fault injectors in `igg.chaos`),
+and the verified tier-degradation ladder (`igg.degrade` — kernel
+quarantine with compile-failure capture, numeric verify-on-first-use
+against the pure-XLA composition truth, observable/resettable status).
 """
 
 from ._compat import install as _compat_install
@@ -85,6 +88,7 @@ from .checkpoint import (
 from .resilience import ResilienceError, RunResult, run_resilient
 from .timing import time_steps
 from . import chaos
+from . import degrade
 from . import device
 from . import profiling
 from . import resilience
@@ -109,6 +113,6 @@ __all__ = [
     "save_checkpoint", "save_checkpoint_sharded", "load_checkpoint",
     "latest_checkpoint", "verify_checkpoint", "verify_checkpoint_distributed",
     "run_resilient", "RunResult", "ResilienceError", "resilience", "chaos",
-    "vis",
+    "degrade", "vis",
     "time_steps", "__version__",
 ]

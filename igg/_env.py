@@ -1,0 +1,114 @@
+"""Centralized parsing of the `IGG_*` environment knobs.
+
+Every environment variable the library reads goes through the typed
+accessors here, against a registry of the known names.  Two robustness
+holes this closes (round 10):
+
+- a typo'd knob (`IGG_ASSEMLBY`, `IGG_VERIFY_KERNEL`) used to be silently
+  ignored — the user believes the override is active and it is not.  The
+  first accessor call scans the process environment for `IGG_`-prefixed
+  names outside the registry and warns ONCE, listing them next to the
+  knobs that exist;
+- an unparsable value (`IGG_CKPT_COMMIT_TIMEOUT=ten`) used to surface as a
+  bare `ValueError` from some call stack deep in a save; the accessors
+  raise `GridError` naming the variable and the expected type instead.
+
+Extensions register their knobs with :func:`register` before first use so
+the unknown-name sweep stays accurate.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Dict, Optional
+
+from .shared import GridError
+
+# The registry: every IGG_* knob the library understands, with the one-line
+# meaning shown when an unrecognized sibling is found.
+_KNOWN: Dict[str, str] = {
+    "IGG_ASSEMBLY": "pin the measured halo-assembly election (xla|writer)",
+    "IGG_CKPT_COMMIT_TIMEOUT":
+        "seconds to wait for sharded-checkpoint commit coordination",
+    "IGG_DIST_INIT_BACKOFF":
+        "initial sleep between jax.distributed.initialize retries (s)",
+    "IGG_DIST_INIT_TIMEOUT":
+        "total seconds to keep retrying jax.distributed.initialize",
+    "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
+    "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
+    "IGG_TPU_TESTS": "1 runs the TPU-only test files on the real backend",
+    "IGG_VERIFY_KERNELS":
+        "1 verifies every kernel tier against the XLA truth on first use",
+}
+
+_warned_unknown = False
+
+
+def register(name: str, doc: str) -> None:
+    """Add an extension's `IGG_*` knob to the known-name registry (call
+    before the first accessor use so the unknown-name sweep stays
+    accurate)."""
+    if not name.startswith("IGG_"):
+        raise GridError(f"_env.register: {name!r} is not an IGG_* name.")
+    _KNOWN[name] = doc
+
+
+def _sweep_unknown() -> None:
+    """One-time warning for `IGG_`-prefixed environment variables the
+    library does not understand — a typo'd knob silently ignored is its
+    own robustness hole."""
+    global _warned_unknown
+    if _warned_unknown:
+        return
+    _warned_unknown = True
+    unknown = sorted(n for n in os.environ
+                     if n.startswith("IGG_") and n not in _KNOWN)
+    if unknown:
+        known = ", ".join(sorted(_KNOWN))
+        warnings.warn(
+            f"igg: unrecognized IGG_* environment variable(s) "
+            f"{', '.join(unknown)} — they have no effect (known knobs: "
+            f"{known}).", stacklevel=3)
+
+
+def text(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw string accessor (registry-checked)."""
+    _sweep_unknown()
+    assert name in _KNOWN, f"unregistered IGG knob {name!r} (add to _env)"
+    return os.environ.get(name, default)
+
+
+def number(name: str, default: float) -> float:
+    val = text(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        raise GridError(f"{name}={val!r} is not a number.") from None
+
+
+def integer(name: str, default: int) -> int:
+    val = text(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        raise GridError(f"{name}={val!r} is not an integer.") from None
+
+
+def flag(name: str, default: bool = False) -> bool:
+    """Boolean knob: "1"/"true"/"yes"/"on" (case-insensitive) are true,
+    "0"/"false"/"no"/"off"/"" are false; anything else raises."""
+    val = text(name)
+    if val is None:
+        return default
+    low = val.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off", ""):
+        return False
+    raise GridError(f"{name}={val!r} is not a boolean "
+                    f"(use 1/0, true/false, yes/no, on/off).")

@@ -29,6 +29,20 @@ one-shot by default so a rolled-back replay does not re-fail:
   traced into the compiled halo programs, so arming/disarming clears the
   compiled-program caches; a recovery policy that calls ``disarm()``
   models a transient link/memory fault that heals on retry.
+- :func:`kernel_compile_fail` / :func:`kernel_corrupt` — the degradation
+  ladder's two failure shapes (round 10), injected through the
+  `igg.degrade._CHAOS_TIER_TAP` dispatch seam (the `_CHAOS_PLANE_TAP`
+  pattern applied to tier dispatch): the first build of the named tier
+  raises a stand-in Mosaic lowering error, or every dispatch of the named
+  tier perturbs one interior output element by `magnitude` (a
+  deterministic miscompile).  Host-level taps — never traced into
+  compiled programs — so arming needs no cache clearing.
+
+Prefer the exception-safe context managers — every injector supports
+``with`` directly, and :func:`armed` composes several — so a test failure
+mid-plan cannot leak an armed tap or stale compiled caches into the next
+test; the imperative ``arm()``/``disarm()`` calls remain as thin wrappers
+over the same state for recovery policies that heal a fault mid-run.
 
 This is a test/CI surface: nothing here is imported by the library's hot
 paths, and the only production-adjacent hook is the documented
@@ -37,6 +51,7 @@ paths, and the only production-adjacent hook is the documented
 
 from __future__ import annotations
 
+import contextlib
 import pathlib
 import zipfile
 from typing import Optional, Sequence, Tuple
@@ -46,7 +61,8 @@ import numpy as np
 from .shared import GridError
 
 __all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
-           "HaloCorruption"]
+           "HaloCorruption", "kernel_compile_fail", "kernel_corrupt",
+           "KernelChaos", "armed"]
 
 
 class ChaosPlan:
@@ -267,3 +283,104 @@ def _install_tap(tap) -> None:
     # have baked in the previous tap state.
     halo.free_update_halo_buffers()
     parallel.free_sharded_cache()
+
+
+class KernelChaos:
+    """Armed tier-dispatch fault (see :func:`kernel_compile_fail` /
+    :func:`kernel_corrupt`): merges its entries into the
+    `igg.degrade._CHAOS_TIER_TAP` seam on `arm()` and removes exactly them
+    on `disarm()`, so several injectors can be armed at once.  Context
+    manager (exception-safe disarm); `disarm()` from a recovery policy
+    models a fault that heals on retry."""
+
+    def __init__(self, kind: str, tier: str, payload):
+        self._kind = kind          # "compile_fail" | "corrupt"
+        self._tier = tier
+        self._payload = payload
+
+    def arm(self) -> "KernelChaos":
+        from . import degrade
+
+        tap = degrade._CHAOS_TIER_TAP or {}
+        tap.setdefault(self._kind, {})[self._tier] = self._payload
+        degrade._CHAOS_TIER_TAP = tap
+        return self
+
+    def disarm(self) -> None:
+        from . import degrade
+
+        tap = degrade._CHAOS_TIER_TAP
+        if not tap:
+            return
+        tap.get(self._kind, {}).pop(self._tier, None)
+        if not any(tap.get(k) for k in tap):
+            degrade._CHAOS_TIER_TAP = None
+
+    def __enter__(self) -> "KernelChaos":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def kernel_compile_fail(tier: str, message: Optional[str] = None) \
+        -> KernelChaos:
+    """Context manager making the FIRST build of ladder tier `tier` (e.g.
+    ``"diffusion3d.mosaic"``, ``"stokes3d.trapezoid"``) raise a stand-in
+    XLA/Mosaic lowering error (`igg.degrade.InjectedCompileError`,
+    carrying `message`) — the toolchain-regression failure shape.  The
+    ladder must capture it, quarantine the tier with reason
+    'compile_failed', and serve the next rung::
+
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            step = diffusion3d.make_step(pallas_interpret=True)
+            T = step(T, Cp)        # served by the XLA truth rung
+    """
+    return KernelChaos("compile_fail", tier, message)
+
+
+def kernel_corrupt(tier: str, magnitude: float = float("nan")) \
+        -> KernelChaos:
+    """Context manager corrupting EVERY dispatch of ladder tier `tier`:
+    one interior element of its first floating output is perturbed by
+    `magnitude` (default NaN — the blowup shape the resilient watchdog
+    detects; a finite magnitude models silent wrong physics, which only
+    `verify="first_use"` can catch).  The deterministic stand-in for a
+    miscompiled kernel: unlike :class:`ChaosPlan` injections it does NOT
+    heal on rollback — recovery requires demoting the tier
+    (`igg.degrade.demote_active`, the `run_resilient` recovery rung)."""
+    return KernelChaos("corrupt", tier, magnitude)
+
+
+@contextlib.contextmanager
+def armed(*injectors):
+    """Arm several injectors for a scope, disarming ALL of them (reverse
+    order) on exit even when the body — or a later injector's `arm()` —
+    raises: the exception-safe composition for tests, where a failure
+    mid-plan must not leak an armed tap or stale compiled caches into the
+    next test.
+
+    Accepts anything with `arm()`/`disarm()` (:class:`HaloCorruption`,
+    :class:`KernelChaos`) plus :class:`ChaosPlan`, whose fired-injection
+    memory is `reset()` on entry AND exit so a consumed plan cannot leak
+    either.  Yields the injectors (singular when one was passed)::
+
+        with igg.chaos.armed(igg.chaos.kernel_corrupt("stokes3d.mosaic"),
+                             igg.chaos.halo_corruption()) as (kc, hc):
+            ...
+    """
+    entered = []
+    try:
+        for inj in injectors:
+            if isinstance(inj, ChaosPlan):
+                inj.reset()
+            else:
+                inj.arm()
+            entered.append(inj)
+        yield injectors[0] if len(injectors) == 1 else injectors
+    finally:
+        for inj in reversed(entered):
+            if isinstance(inj, ChaosPlan):
+                inj.reset()
+            else:
+                inj.disarm()

@@ -785,9 +785,9 @@ def _local_block_refs(grid, fields) -> Dict[int, Dict[str, object]]:
 
 
 def _commit_timeout_s() -> float:
-    import os
+    from . import _env
 
-    return float(os.environ.get("IGG_CKPT_COMMIT_TIMEOUT", "600"))
+    return _env.number("IGG_CKPT_COMMIT_TIMEOUT", 600)
 
 
 def _await_files(base: pathlib.Path, names, what: str,

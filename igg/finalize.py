@@ -26,10 +26,14 @@ def finalize_global_grid(*, shutdown_distributed: bool = False) -> None:
     from .gather import free_gather_buffer
     from .parallel import free_sharded_cache
     from .tools import free_barrier_cache
+    from . import degrade
     free_update_halo_buffers()
     free_gather_buffer()
     free_sharded_cache()
     free_barrier_cache()
+    # Ladder state (quarantine, verification memory, events) is grid-scoped
+    # observability: a re-initialized grid starts with every tier admitted.
+    degrade.reset()
 
     if shutdown_distributed and grid.distributed:
         import jax

@@ -856,6 +856,22 @@ _PALLAS_UNSUPPORTED = (
     "igg/ops/halo_write.py); use the default or 'xla'.")
 
 
+def _raise_pallas_unsupported():
+    """The forced-writer (`assembly='pallas'`) refusal: names the
+    quarantine when that is why the writers cannot serve, the capability
+    contract otherwise."""
+    from . import degrade
+
+    q = degrade.status().get(degrade.HALO_WRITER_TIER)
+    if q is not None:
+        raise GridError(
+            f"assembly='pallas' was forced but the writer tier is "
+            f"quarantined ({q.reason}): {q.error or '<no capture>'}.  "
+            f"igg.degrade.reset({degrade.HALO_WRITER_TIER!r}) re-admits "
+            f"it.")
+    raise GridError(_PALLAS_UNSUPPORTED)
+
+
 def _check_assembly(assembly):
     if assembly not in _ASSEMBLY_MODES:
         raise GridError(
@@ -895,7 +911,7 @@ def assemble_field(out, recv: Dict, dims_active, grid, assembly=None):
     _, use_writer = _writer_dims(out, dims_active, grid, all_ext=True)
     if not use_writer:
         if assembly == "pallas":
-            raise GridError(_PALLAS_UNSUPPORTED)
+            _raise_pallas_unsupported()
         return xla_assemble(out, recv)
     specs = [(d, "ext", jnp.squeeze(recv[d][0], d),
               jnp.squeeze(recv[d][1], d)) for d, _ in dims_active]
@@ -921,12 +937,22 @@ def _writer_dims(A, dims, grid, all_ext: bool = False):
     f64 rides the PINNED XLA plan: `_assembly_plan` deterministically
     picks aligned-DUS for tile-aligned shapes (masked-select otherwise),
     the reference-default-Float64 story of VERDICT r3 item 4's fallback
-    clause."""
+    clause.
+
+    A quarantined writer tier (`igg.degrade.HALO_WRITER_TIER` — a captured
+    Mosaic compile failure, or an explicit `igg.degrade.quarantine`) turns
+    the writers off here, the single election point every assembly path
+    consults, so the XLA plans serve instead; quarantining/resetting the
+    tier clears the compiled halo caches because this decision is read at
+    TRACE time."""
+    from . import degrade
     from .ops.halo_write import (ext_planes_supported, halo_write_supported,
                                  slab_write_supported)
 
     wraps = frozenset(d for d, _ in dims
                       if grid.dims[d] == 1 and grid.periods[d])
+    if degrade.is_quarantined(degrade.HALO_WRITER_TIER):
+        return wraps, False
     dd = [d for d, _ in dims]
     lane_active = any(d == A.ndim - 1 for d, _ in dims)
     interp = _FORCE_WRITER_INTERPRET
@@ -981,7 +1007,7 @@ def _update_halo_impl(fields: List, grid, assembly=None) -> Tuple:
                          and assembly != "xla"
                          else (frozenset(), False))
         if assembly == "pallas" and dims and not use_writer:
-            raise GridError(_PALLAS_UNSUPPORTED)
+            _raise_pallas_unsupported()
         dims_moving.append(dims)
         writer.append(use_writer)
         wraps.append(w if use_writer else frozenset())
@@ -1156,15 +1182,48 @@ def update_halo(*fields, assembly=None):
 
     key = (shared.grid_epoch(), assembly,
            tuple((A.shape, str(A.dtype)) for A in fields))
-    fn = _compiled.get(key)
-    if fn is None:
+
+    def build():
         specs = tuple(spec_for(A.ndim) for A in fields)
         sm = jax.shard_map(
             lambda *fs: _update_halo_impl(list(fs), grid, assembly=assembly),
             mesh=grid.mesh, in_specs=specs, out_specs=specs)
-        fn = jax.jit(sm, donate_argnums=tuple(range(len(fields))))
-        _compiled[key] = fn
-    out = fn(*fields)
+        return jax.jit(sm, donate_argnums=tuple(range(len(fields))))
+
+    from . import degrade
+
+    fn = _compiled.get(key)
+    first = fn is None
+    if first:
+        fn = _compiled[key] = build()
+    writer_possible = (
+        assembly is None and (_is_tpu(grid) or _FORCE_WRITER_INTERPRET)
+        and not degrade.is_quarantined(degrade.HALO_WRITER_TIER))
+    try:
+        if first and writer_possible:
+            # Chaos seam (igg.chaos.kernel_compile_fail("halo.writer")).
+            degrade._chaos_compile_check(degrade.HALO_WRITER_TIER)
+        out = fn(*fields)
+    except Exception as e:
+        # Compile-failure capture for the writer tier (igg.degrade): a
+        # Mosaic/XLA lowering error on the FIRST build of this program,
+        # while the writers could have been elected, quarantines the tier
+        # and re-traces with the XLA plans — the fast tier is an
+        # optimization, never a correctness dependency.  (Errors on an
+        # already-serving program, forced assemblies, and programs the
+        # writers never entered propagate: they are real.)  The program
+        # donates its inputs, so only pre-execution failures — which leave
+        # the arguments alive — are capturable; a post-donation runtime
+        # error has consumed the buffers, cannot be retried, and says
+        # nothing about the writer kernels, so it propagates unclaimed.
+        if not (first and writer_possible):
+            raise
+        if any(getattr(a, "is_deleted", lambda: False)() for a in fields):
+            raise
+        _compiled.pop(key, None)
+        degrade.quarantine(degrade.HALO_WRITER_TIER, 0, "compile_failed", e)
+        fn = _compiled[key] = build()   # re-trace: _writer_dims now refuses
+        out = fn(*fields)
     if grid.needs_cpu_sync:
         jax.block_until_ready(out)
     return out[0] if len(fields) == 1 else out

@@ -37,8 +37,10 @@ def _init_distributed_with_retry() -> int:
 
     import jax
 
-    timeout = float(os.environ.get("IGG_DIST_INIT_TIMEOUT", "300"))
-    delay = float(os.environ.get("IGG_DIST_INIT_BACKOFF", "1"))
+    from . import _env
+
+    timeout = _env.number("IGG_DIST_INIT_TIMEOUT", 300)
+    delay = _env.number("IGG_DIST_INIT_BACKOFF", 1)
     deadline = time.monotonic() + timeout
     attempt = 0
     while True:

@@ -5,10 +5,16 @@ step factory: `use_pallas="auto"` (default) routes to the fused Pallas
 kernel when it applies and silently falls back to the portable
 shard_map/XLA path otherwise; `False` forces the XLA path; `True` requires
 the kernel and raises `GridError` with the family's requirement string.
-This module is the single implementation of that contract (applicability
-probe + lazily-built sharded pallas path), parameterized by the family's
-`supported(grid, field)` gate, requirement message, and fused-step
-builder."""
+This module is the single implementation of that contract, parameterized
+by the family's `supported(grid, field)` gate, requirement message, and
+fused-step builder.
+
+Round 10: the contract is realized as an :class:`igg.degrade.Ladder` —
+every dispatch walks the family's tier ladder (optional extra rungs like
+the Stokes trapezoid chunk tier → the fused Mosaic rung → the pure-XLA
+composition truth rung), so compile-failure capture, kernel quarantine,
+and numeric verify-on-first-use apply uniformly to every family (see
+`igg/degrade.py`)."""
 
 from __future__ import annotations
 
@@ -16,30 +22,44 @@ import igg
 
 
 def pallas_applicable(use_pallas, field, *, supported_fn, requirement,
-                      interpret: bool = False) -> bool:
+                      interpret: bool = False):
     """The auto/True/False applicability probe: TPU devices (or interpret
-    mode), f32 fields, and the family's `supported_fn` gate.  Raises
-    `GridError(requirement)` when `use_pallas is True` but the kernel is
-    inapplicable."""
+    mode), f32 fields, and the family's `supported_fn` gate.  Returns an
+    :class:`igg.degrade.Admission` (truthy/falsy, with the structured
+    refusal reason); raises `GridError(requirement)` when `use_pallas is
+    True` but the kernel is inapplicable."""
     import inspect
 
     import jax.numpy as jnp
 
-    if use_pallas is False:
-        return False
-    grid = igg.get_global_grid()
-    platform_ok = (interpret
-                   or next(iter(grid.mesh.devices.flat)).platform == "tpu")
-    # Gates that distinguish interpret mode (no Mosaic, no VMEM budget —
-    # stokes/hm3d) receive the flag; older two-arg gates are unchanged.
-    kw = ({"interpret": interpret}
-          if "interpret" in inspect.signature(supported_fn).parameters
-          else {})
-    ok = (platform_ok and field.dtype == jnp.float32
-          and supported_fn(grid, field, **kw))
-    if use_pallas is True and not ok:
+    from igg.degrade import Admission
+
+    def probe():
+        if use_pallas is False:
+            return Admission.no("use_pallas=False pins the XLA path")
+        grid = igg.get_global_grid()
+        if not (interpret
+                or next(iter(grid.mesh.devices.flat)).platform == "tpu"):
+            return Admission.no("devices are not TPU (and interpret mode "
+                                "is off)")
+        if field.dtype != jnp.float32:
+            return Admission.no(f"dtype {field.dtype} is not float32")
+        # Gates that distinguish interpret mode (no Mosaic, no VMEM budget
+        # — stokes/hm3d) receive the flag; older two-arg gates are
+        # unchanged.
+        kw = ({"interpret": interpret}
+              if "interpret" in inspect.signature(supported_fn).parameters
+              else {})
+        ok = supported_fn(grid, field, **kw)
+        if isinstance(ok, Admission):
+            return ok
+        return Admission.yes() if ok else Admission.no(
+            "the family's admission gate refused the field/grid")
+
+    adm = probe()
+    if use_pallas is True and not adm:
         raise igg.GridError(requirement)
-    return ok
+    return adm
 
 
 # Measured assembly choices, keyed by (model tag, grid epoch, arg
@@ -119,11 +139,10 @@ def measured_assembly_path(build_variant, *, tag: str, wrap):
     The measurement is skipped — with a fixed "writer" default, the
     engine's standalone-optimal strategy — when it cannot run safely or
     meaningfully: non-TPU meshes (the writers never engage; "xla"),
-    multi-controller runs (per-process wall clocks could elect different
-    variants and the processes would then execute divergent SPMD
-    programs), or an `IGG_ASSEMBLY` override."""
-    import os
-
+    a quarantined writer tier (igg.degrade), multi-controller runs
+    (per-process wall clocks could elect different variants and the
+    processes would then execute divergent SPMD programs), or an
+    `IGG_ASSEMBLY` override."""
     import igg
     from igg import shared
     from igg.halo import _is_tpu
@@ -143,12 +162,17 @@ def measured_assembly_path(build_variant, *, tag: str, wrap):
     def dispatch(*args):
         import jax
 
-        from igg import halo
+        from igg import _env, degrade, halo
 
         grid = shared.global_grid()
         if not (_is_tpu(grid) or halo._FORCE_WRITER_INTERPRET):
             return variant("xla")(*args)
-        forced = os.environ.get("IGG_ASSEMBLY")
+        if degrade.is_quarantined(degrade.HALO_WRITER_TIER):
+            # The writer tier is quarantined (see igg/halo.py): skip the
+            # election — a measured "writer" choice could no longer engage
+            # the writers and would just mislead the cache.
+            return variant("xla")(*args)
+        forced = _env.text("IGG_ASSEMBLY")
         if forced in ("xla", "writer"):
             return variant(forced)(*args)
         if jax.process_count() > 1:
@@ -178,25 +202,50 @@ def measured_assembly_path(build_variant, *, tag: str, wrap):
 
 
 def auto_dispatch(*, use_pallas, interpret, supported_fn, requirement,
-                  xla_path, build_pallas_steps, donate_argnums):
-    """The compiled-entry dispatcher shared by the model factories:
-    per-call applicability probe on the first field argument, lazily
-    compiling the fused path through `igg.sharded` on first use.
+                  xla_path, build_pallas_steps, donate_argnums,
+                  family: str = "model", verify=None, extra_tiers=()):
+    """The compiled-entry dispatcher shared by the model factories: a
+    per-family :class:`igg.degrade.Ladder` whose rungs are `extra_tiers`
+    (family-specific fast tiers, e.g. the Stokes trapezoid chunk tier) →
+    the fused Mosaic tier (`{family}.mosaic`, admission-probed per call on
+    the first field argument, lazily compiled through `igg.sharded` on
+    first use) → the pure-XLA composition truth tier (`{family}.xla`).
+    Every dispatch gets the ladder's runtime guards: quarantine skip,
+    compile-failure capture, and — with `verify="first_use"` (or
+    `IGG_VERIFY_KERNELS=1`) — a one-time numeric check of each fast tier
+    against the truth rung before it serves real traffic.
 
     `build_pallas_steps()` returns the local (per-device) fused step
     function; `check_vma=not interpret` works around interpret-mode
-    pallas_call not propagating shard_map's varying-manual-axes metadata."""
-    pallas_path = None
+    pallas_call not propagating shard_map's varying-manual-axes metadata.
+    `extra_tiers` is a sequence of `igg.degrade.Tier` placed above the
+    Mosaic rung (their `rung` indices are assigned by position).  The
+    returned callable exposes the ladder as `.ladder` for observability
+    and benchmarks."""
+    from igg.degrade import Ladder, Tier
+
+    def admit_mosaic(args):
+        return pallas_applicable(use_pallas, args[0],
+                                 supported_fn=supported_fn,
+                                 requirement=requirement, interpret=interpret)
+
+    def build_mosaic():
+        return igg.sharded(build_pallas_steps(),
+                           donate_argnums=donate_argnums,
+                           check_vma=not interpret)
+
+    tiers = list(extra_tiers)
+    tiers.append(Tier(name=f"{family}.mosaic", rung=0, build=build_mosaic,
+                      admit=admit_mosaic, required=use_pallas is True,
+                      requirement=requirement))
+    tiers.append(Tier(name=f"{family}.xla", rung=0,
+                      build=lambda: xla_path, truth=True))
+    for i, t in enumerate(tiers):
+        t.rung = i
+    ladder = Ladder(family, tiers, verify=verify)
 
     def dispatch(*args):
-        nonlocal pallas_path
-        if pallas_applicable(use_pallas, args[0], supported_fn=supported_fn,
-                             requirement=requirement, interpret=interpret):
-            if pallas_path is None:
-                pallas_path = igg.sharded(
-                    build_pallas_steps(), donate_argnums=donate_argnums,
-                    check_vma=not interpret)
-            return pallas_path(*args)
-        return xla_path(*args)
+        return ladder.dispatch(*args)
 
+    dispatch.ladder = ladder
     return dispatch

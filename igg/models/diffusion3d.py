@@ -141,7 +141,7 @@ def _best_bx(S0: int) -> int:
 
 def make_step(params: Params = Params(), *, donate: bool = True,
               use_pallas="auto", overlap: bool = False,
-              pallas_interpret: bool = False):
+              pallas_interpret: bool = False, verify=None):
     """Compiled whole-step function `(T, Cp) -> T` over the grid mesh.
 
     `use_pallas`: "auto" (default) uses the fused Pallas kernel
@@ -153,15 +153,19 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     Pallas step has overlap semantics built in — its halo exchange is always
     data-independent of the main kernel).
     `pallas_interpret`: run the kernel in interpret mode (testing on CPU).
+    `verify`: "first_use" numerically checks the fused tier against the
+    XLA composition before it serves traffic (`igg.degrade`; defaults to
+    the `IGG_VERIFY_KERNELS` environment knob).
     """
     return make_multi_step(1, params, donate=donate, use_pallas=use_pallas,
-                           overlap=overlap, pallas_interpret=pallas_interpret)
+                           overlap=overlap, pallas_interpret=pallas_interpret,
+                           verify=verify)
 
 
 def make_multi_step(n_inner: int, params: Params = Params(), *,
                     donate: bool = True, use_pallas="auto",
                     overlap: bool = False, pallas_interpret: bool = False,
-                    bx: int = None):
+                    bx: int = None, verify=None):
     """Compiled `(T, Cp) -> T` advancing `n_inner` steps in ONE XLA program
     (`lax.fori_loop` around the step, halo ppermutes included).  This is the
     TPU-idiomatic time loop: host dispatch overhead amortizes to zero, and
@@ -230,7 +234,8 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
         use_pallas=use_pallas, interpret=pallas_interpret,
         supported_fn=pallas_supported, requirement=_PALLAS_REQ,
         xla_path=xla_path, build_pallas_steps=build_pallas_steps,
-        donate_argnums=(0,) if donate else ())
+        donate_argnums=(0,) if donate else (),
+        family="diffusion3d", verify=verify)
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,

@@ -146,7 +146,8 @@ def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
 
 def make_step(params: Params = Params(), *, donate: bool = True,
               overlap: bool = False, n_inner: int = 1,
-              use_pallas="auto", pallas_interpret: bool = False):
+              use_pallas="auto", pallas_interpret: bool = False,
+              verify=None):
     """Compiled `(Pe, phi) -> (Pe, phi)` advancing `n_inner` steps in one
     SPMD program.  `use_pallas`: "auto" (default) uses the fused kernel
     (`igg.ops.fused_hm3d_steps`, with boundary-slab carry) when it applies —
@@ -155,7 +156,10 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     and raises if inapplicable.  `overlap` restructures the XLA path with
     `igg.hide_communication`; the fused kernel has overlap semantics built
     in (its exchange is always data-independent of the main kernel), so it
-    satisfies both settings — exactly like diffusion3d."""
+    satisfies both settings — exactly like diffusion3d.
+    `verify`: "first_use" numerically checks the fused tier against the
+    XLA composition before it serves traffic (`igg.degrade`; defaults to
+    the `IGG_VERIFY_KERNELS` environment knob)."""
     from jax import lax
 
     dx, dy, dz = params.spacing()
@@ -200,7 +204,8 @@ def make_step(params: Params = Params(), *, donate: bool = True,
         use_pallas=use_pallas, interpret=pallas_interpret,
         supported_fn=hm3d_pallas_supported, requirement=_PALLAS_REQ,
         xla_path=xla_path, build_pallas_steps=build_pallas_steps,
-        donate_argnums=(0, 1) if donate else ())
+        donate_argnums=(0, 1) if donate else (),
+        family="hm3d", verify=verify)
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,

@@ -210,7 +210,7 @@ def _pseudo_steps(params: Params):
 def make_iteration(params: Params = Params(), *, donate: bool = True,
                    overlap: bool = False, n_inner: int = 1,
                    use_pallas="auto", pallas_interpret: bool = False,
-                   trapezoid="auto", K: int = None):
+                   trapezoid="auto", K: int = None, verify=None):
     """Compiled `(P, Vx, Vy, Vz, Rho) -> (P, Vx, Vy, Vz)` advancing
     `n_inner` iterations in one SPMD program.  `use_pallas`: "auto"
     (default) uses the fused kernel when it applies — TPU devices,
@@ -227,7 +227,15 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     remainder through the per-iteration kernel); False pins the
     per-iteration kernel; True requires the chunk tier and raises
     `GridError` when inapplicable.  `K` overrides the auto-fitted chunk
-    depth (`fit_stokes_K`)."""
+    depth (`fit_stokes_K`).
+
+    The factory's dispatch is the family's degradation ladder
+    (`igg.degrade`): `stokes3d.trapezoid` → `stokes3d.mosaic` (the
+    per-iteration fused kernel) → `stokes3d.xla` (the composition truth),
+    so a quarantined chunk tier falls to the per-iteration kernel and a
+    quarantined kernel falls to pure XLA.  `verify="first_use"` (or
+    `IGG_VERIFY_KERNELS=1`) numerically checks each fast tier against the
+    truth before it serves traffic."""
     from jax import lax
 
     kw = _pseudo_steps(params)
@@ -261,43 +269,79 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     if trapezoid is True:
         use_pallas = True    # the chunk tier rides the fused kernel
 
-    def build_pallas_steps():
-        from igg.ops import fused_stokes_iteration
+    donate_argnums = (0, 1, 2, 3) if donate else ()
+
+    def _fit_K(grid, lshape, dtype):
+        """The chunk depth the trapezoid tier will run (0 when none
+        applies) — shared by the tier's admission gate and its traced
+        body so the two can never disagree."""
         from igg.ops.stokes_trapezoid import (fit_stokes_K,
-                                              fused_stokes_trapezoid_iters,
                                               stokes_trapezoid_supported)
 
-        def pallas_it(P, Vx, Vy, Vz, Rho):
+        if trapezoid is False or n_inner < 3:
+            return 0
+        if K is not None:
+            return K if stokes_trapezoid_supported(
+                grid, tuple(lshape), K, n_inner - 1, dtype,
+                interpret=pallas_interpret) else 0
+        return fit_stokes_K(grid, tuple(lshape), n_inner - 1, dtype,
+                            interpret=pallas_interpret)
+
+    def admit_trapezoid(args):
+        from igg.degrade import Admission
+        from igg.ops import stokes_pallas_supported
+
+        from ._dispatch import pallas_applicable
+
+        if trapezoid is False:
+            return Admission.no("trapezoid=False pins the per-iteration "
+                                "kernel")
+        # Non-raising base probe ("auto", never the forced form): the
+        # chunk tier rides the fused kernel, but a use_pallas=True refusal
+        # belongs to the mosaic rung.
+        base = pallas_applicable("auto", args[0],
+                                 supported_fn=stokes_pallas_supported,
+                                 requirement=_PALLAS_REQ,
+                                 interpret=pallas_interpret)
+        if not base:
+            return Admission.no(f"fused per-iteration kernel (the chunk "
+                                f"tier's carrier) inadmissible: "
+                                f"{getattr(base, 'reason', '')}")
+        if n_inner < 3:
+            return Admission.no(f"n_inner={n_inner} < 3: no warm-up plus "
+                                f"full chunk fits")
+        grid = igg.get_global_grid()
+        P = args[0]
+        if not _fit_K(grid, grid.local_shape_any(P), P.dtype):
+            return Admission.no(
+                "no chunk depth K admissible "
+                "(igg.ops.stokes_trapezoid_supported)")
+        return Admission.yes()
+
+    def build_trapezoid():
+        from igg.ops import fused_stokes_iteration
+        from igg.ops.stokes_trapezoid import fused_stokes_trapezoid_iters
+
+        def trap_it(P, Vx, Vy, Vz, Rho):
             # Built inside the closure: the cells must stay hashable
             # scalars so recreated closures share one compiled program
             # (`igg.parallel._fn_key`, see the NOTE above).
             kw_it = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
             grid = igg.get_global_grid()
-            state = (P, Vx, Vy, Vz)
-            n = n_inner
-            Kf = 0
-            if trapezoid is not False and n_inner >= 3:
-                if K is not None:
-                    Kf = K if stokes_trapezoid_supported(
-                        grid, P.shape, K, n_inner - 1, P.dtype,
-                        interpret=pallas_interpret) else 0
-                else:
-                    Kf = fit_stokes_K(grid, P.shape, n_inner - 1, P.dtype,
-                                      interpret=pallas_interpret)
-            if trapezoid is True and not Kf:
+            Kf = _fit_K(grid, P.shape, P.dtype)   # local block inside sharded
+            if not Kf:    # admission gate and trace share _fit_K
                 raise igg.GridError(_TRAPEZOID_REQ)
-            if Kf:
-                # Warm-up per-iteration kernel: consumes (and replaces)
-                # the entry halos exactly like every other path — the
-                # exchange-fresh window state the chunk's validity
-                # argument requires, for ANY input.
-                state = fused_stokes_iteration(
-                    *state, Rho, **kw_it, interpret=pallas_interpret)
-                *state, done = fused_stokes_trapezoid_iters(
-                    *state, Rho, n_inner=n_inner - 1, K=Kf, **kw_it,
-                    interpret=pallas_interpret)
-                n = n_inner - 1 - done
-            if n:
+            # Warm-up per-iteration kernel: consumes (and replaces) the
+            # entry halos exactly like every other path — the
+            # exchange-fresh window state the chunk's validity argument
+            # requires, for ANY input.
+            state = fused_stokes_iteration(
+                P, Vx, Vy, Vz, Rho, **kw_it, interpret=pallas_interpret)
+            *state, done = fused_stokes_trapezoid_iters(
+                *state, Rho, n_inner=n_inner - 1, K=Kf, **kw_it,
+                interpret=pallas_interpret)
+            n = n_inner - 1 - done
+            if n:    # remainder through the per-iteration kernel
                 state = lax.fori_loop(
                     0, n,
                     lambda _, S: fused_stokes_iteration(
@@ -305,17 +349,36 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
                     tuple(state))
             return tuple(state)
 
+        return igg.sharded(trap_it, donate_argnums=donate_argnums,
+                           check_vma=not pallas_interpret)
+
+    def build_pallas_steps():
+        from igg.ops import fused_stokes_iteration
+
+        def pallas_it(P, Vx, Vy, Vz, Rho):
+            kw_it = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
+            return lax.fori_loop(
+                0, n_inner,
+                lambda _, S: fused_stokes_iteration(
+                    *S, Rho, **kw_it, interpret=pallas_interpret),
+                (P, Vx, Vy, Vz))
+
         return pallas_it
 
+    from igg.degrade import Tier
     from igg.ops import stokes_pallas_supported
 
     from ._dispatch import auto_dispatch
 
+    trap_tier = Tier(name="stokes3d.trapezoid", rung=0,
+                     build=build_trapezoid, admit=admit_trapezoid,
+                     required=trapezoid is True, requirement=_TRAPEZOID_REQ)
     return auto_dispatch(
         use_pallas=use_pallas, interpret=pallas_interpret,
         supported_fn=stokes_pallas_supported, requirement=_PALLAS_REQ,
         xla_path=xla_path, build_pallas_steps=build_pallas_steps,
-        donate_argnums=(0, 1, 2, 3) if donate else ())
+        donate_argnums=donate_argnums,
+        family="stokes3d", verify=verify, extra_tiers=(trap_tier,))
 
 
 def run(n_iters: int, params: Params = Params(), dtype=np.float32,

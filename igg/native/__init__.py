@@ -66,7 +66,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if os.environ.get("IGG_NATIVE", "1") == "0":
+    from .. import _env
+
+    if not _env.flag("IGG_NATIVE", True):
         return None
     try:
         lib = ctypes.CDLL(build())
@@ -89,12 +91,11 @@ def available() -> bool:
 
 
 def _nthreads() -> int:
-    env = os.environ.get("IGG_NATIVE_THREADS")
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
+    from .. import _env
+
+    n = _env.integer("IGG_NATIVE_THREADS", 0)
+    if n > 0:
+        return n
     return min(16, os.cpu_count() or 1)
 
 

@@ -72,17 +72,29 @@ from __future__ import annotations
 from functools import partial
 
 
-def pallas_supported(grid, T) -> bool:
+def pallas_supported(grid, T):
     """Whether the fused kernel applies: 3-D unstaggered f32-shaped field
     with overlap 2 in every dimension, local block large enough to slab
     (any device count and any periodicity — the exchange engine handles
-    open boundaries and multi-device meshes)."""
-    if grid.overlaps != (2, 2, 2) or T.ndim != 3:
-        return False
+    open boundaries and multi-device meshes).  Returns an
+    :class:`igg.degrade.Admission` (truthy/falsy) carrying the structured
+    refusal reason."""
+    from ..degrade import Admission
+
+    if grid.overlaps != (2, 2, 2):
+        return Admission.no(f"grid overlaps {grid.overlaps} != (2, 2, 2)")
+    if T.ndim != 3:
+        return Admission.no(f"field rank {T.ndim} != 3")
     s = tuple(grid.local_shape_any(T))
     if s != tuple(grid.nxyz):
-        return False
-    return s[0] % 4 == 0 and s[1] >= 8 and s[2] >= 128
+        return Admission.no(f"staggered local shape {s} != grid block "
+                            f"{tuple(grid.nxyz)}")
+    if s[0] % 4 != 0:
+        return Admission.no(f"local x extent {s[0]} not a multiple of 4")
+    if s[1] < 8 or s[2] < 128:
+        return Admission.no(f"local block {s} too small to slab "
+                            f"(needs y >= 8, z >= 128)")
+    return Admission.yes()
 
 
 def diffusion_compute(T, A, *, rdx2, rdy2, rdz2):

@@ -154,13 +154,14 @@ def _edge_flags(modes, grid):
 
 def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
                         force_y_ext=None, force_z_ext=None,
-                        allow_open: bool = False) -> bool:
+                        allow_open: bool = False):
     """Whether the K=bx trapezoidal chunk path applies: periodic rings
     along every dimension (self-wrap or extended), at least one full
     chunk, the K-slab sends must lie inside the block, and the extended
     coefficient plus working buffers must fit in VMEM (the interpret-mode
     XLA fallback obeys the same gates so both modes take the same
-    route).
+    route).  Returns an :class:`igg.degrade.Admission` (truthy/falsy)
+    carrying the structured refusal reason.
 
     `allow_open=True` additionally admits open (non-periodic) dimensions
     — the "oext"/"frozen" modes of `_dim_modes`, realized by BOTH the
@@ -170,38 +171,48 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     tier.  The default stays False so direct callers opt in explicitly."""
     import numpy as np
 
+    from ..degrade import Admission
+
     if n_inner < bx or bx < 2:
-        return False
+        return Admission.no(f"n_inner={n_inner} holds no full K={bx} chunk "
+                            f"(needs n_inner >= bx >= 2)")
     if getattr(grid, "disp", 1) != 1:
         # The chunked slab exchange hardwires +-1 ppermute tables
         # (`_extend_dim`); disp > 1 grids take the per-step path, whose
         # engine-level exchange honors `grid.disp`.
-        return False
+        return Admission.no(f"grid disp {grid.disp} != 1 (chunk slab "
+                            f"exchange hardwires +-1 ppermute tables)")
     modes = _dim_modes(grid, force_y_ext, force_z_ext)
     if not allow_open and any(m in ("oext", "frozen") for m in modes):
-        return False
+        return Admission.no(f"open (non-periodic) dimensions {modes} and "
+                            f"the caller did not pass allow_open=True")
     y_ext = modes[1] in ("ext", "oext")
     z_ext = modes[2] in ("ext", "oext")
     S0, S1, S2 = shape
     K = bx
     olx = grid.ol_of_local(0, shape)
     if olx < 2 or S0 % bx != 0:
-        return False
+        return Admission.no(f"x extent {S0} (overlap {olx}) not chunkable "
+                            f"at K={bx} (needs ol >= 2, S0 % K == 0)")
     if modes[0] != "frozen" and (S0 - olx - K < 0 or olx + K > S0):
         # x send slabs inside the block (no slabs in frozen mode)
-        return False
+        return Admission.no(f"K={K} x send slabs fall outside the local "
+                            f"block (S0={S0}, ol={olx})")
     if modes[0] == "frozen" and S0 // bx < 2:
         # The kernel's edge programs fetch their own clamped segments;
         # with one program both edge branches would collide on one slot.
-        return False
+        return Admission.no(f"frozen-x block needs >= 2 band programs "
+                            f"(S0={S0}, K={bx})")
     if S1 % 8 != 0:
         # Mosaic requires tile-aligned VMEM memref slices of the double-
         # buffered scratch; sublane extent must be 8-aligned (f32).
-        return False
+        return Admission.no(f"y extent {S1} not a multiple of 8 (Mosaic "
+                            f"sublane tile)")
     if not z_ext and S2 % 128 != 0:
         # Ditto for the lane extent; in z-extended mode the kernel
         # right-pads the extended extent to a 128 multiple instead.
-        return False
+        return Admission.no(f"z extent {S2} not a multiple of 128 (Mosaic "
+                            f"lane tile; z not extended)")
     S1e, S2e = S1, S2
     if y_ext:
         oly = grid.ol_of_local(1, shape)
@@ -210,9 +221,11 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         # gated unconditionally above); the y send slabs must lie inside
         # the block.
         if oly < 2 or K % 8 != 0:
-            return False
+            return Admission.no(f"y-extended chunk needs ol >= 2 and "
+                                f"K % 8 == 0 (ol={oly}, K={K})")
         if S1 - oly - K < 0 or oly + K > S1:
-            return False
+            return Admission.no(f"K={K} y send slabs fall outside the "
+                                f"local block (S1={S1}, ol={oly})")
         S1e = S1 + 2 * K
     if z_ext:
         olz = grid.ol_of_local(2, shape)
@@ -223,9 +236,11 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
         # VMEM lane slices); the K-offset central z slice is a relayout
         # pass amortized 1/K per step.
         if olz < 2:
-            return False
+            return Admission.no(f"z-extended chunk needs overlap >= 2 "
+                                f"(ol={olz})")
         if S2 - olz - K < 0 or olz + K > S2:
-            return False
+            return Admission.no(f"K={K} z send slabs fall outside the "
+                                f"local block (S2={S2}, ol={olz})")
         S2e = ((S2 + 2 * K + 127) // 128) * 128
     S0e = S0 + (2 * K if modes[0] != "frozen" else 0)
     itemsize = np.dtype(dtype).itemsize
@@ -236,7 +251,10 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     for d, plane in ((0, S1e * S2e), (1, S0e * S2e), (2, S0e * S1e)):
         if modes[d] in ("oext", "frozen"):
             need += 2 * itemsize * plane
-    return need <= _VMEM_BUDGET
+    if need > _VMEM_BUDGET:
+        return Admission.no(f"resident working set {need} bytes exceeds "
+                            f"the VMEM budget {_VMEM_BUDGET}")
+    return Admission.yes()
 
 
 def _kernel(*refs, K, bx, nbe, nbo, off, S0e, S1e, S2, modes, frz,

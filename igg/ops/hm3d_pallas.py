@@ -97,25 +97,37 @@ def _fit_bx(bx: int, S0: int, S1: int, S2: int,
                   check_vmem=check_vmem)
 
 
-def hm3d_pallas_supported(grid, Pe, interpret: bool = False) -> bool:
+def hm3d_pallas_supported(grid, Pe, interpret: bool = False):
     """Whether the fused step applies: 3-D unstaggered overlap-2 grid (any
     device count and any periodicity — the exchange engine handles open
     boundaries and multi-device meshes), local blocks large enough to slab.
     A recv-mode z dimension (exchanged or open) additionally needs z >= 128:
-    its compact slab emission is an in-kernel lane extraction."""
-    if grid.overlaps != (2, 2, 2) or Pe.ndim != 3:
-        return False
+    its compact slab emission is an in-kernel lane extraction.  Returns an
+    :class:`igg.degrade.Admission` (truthy/falsy) carrying the structured
+    refusal reason."""
+    from ..degrade import Admission
+
+    if grid.overlaps != (2, 2, 2):
+        return Admission.no(f"grid overlaps {grid.overlaps} != (2, 2, 2)")
+    if Pe.ndim != 3:
+        return Admission.no(f"field rank {Pe.ndim} != 3")
     s = tuple(grid.local_shape_any(Pe))
     if s != tuple(grid.nxyz):
-        return False
+        return Admission.no(f"staggered local shape {s} != grid block "
+                            f"{tuple(grid.nxyz)}")
     if not (s[0] % 4 == 0 and s[0] >= 8 and s[1] >= 8 and s[2] >= 8):
-        return False
+        return Admission.no(f"local block {s} too small to slab "
+                            f"(needs x % 4 == 0, x >= 8, y >= 8, z >= 8)")
     _, wz = _wrap_dims(grid)
     if not (wz or s[2] >= 128):
-        return False
+        return Admission.no(f"recv-mode z extent {s[2]} < 128 (in-kernel "
+                            f"lane extraction needs a full lane tile)")
     # Some slab height must fit the VMEM cap in compiled mode (512^3-class
     # y*z areas overflow the fixed budget — round 5).
-    return _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) >= 2
+    if _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) < 2:
+        return Admission.no(f"no slab height bx >= 2 fits the VMEM budget "
+                            f"for local y*z area {s[1]}x{s[2]}")
+    return Admission.yes()
 
 
 def _updated(wPe, wphi, kw):

@@ -119,21 +119,32 @@ def _fit_bx(bx: int, S0: int, S1: int, S2: int,
                   check_vmem=check_vmem)
 
 
-def stokes_pallas_supported(grid, P, interpret: bool = False) -> bool:
+def stokes_pallas_supported(grid, P, interpret: bool = False):
     """Whether the fused iteration applies: overlap-3 grid (any device
     count and any periodicity — the exchange engine handles open boundaries
     and multi-device meshes), unstaggered-pressure local block large enough
     to slab, and some slab height whose windows fit VMEM (large y*z areas
     push the per-slab windows past the budget — caught by the round-5
-    256^3 probe, where the unguarded kernel OOM'd at Mosaic compile)."""
-    if grid.overlaps != (3, 3, 3) or P.ndim != 3:
-        return False
+    256^3 probe, where the unguarded kernel OOM'd at Mosaic compile).
+    Returns an :class:`igg.degrade.Admission` (truthy/falsy) carrying the
+    structured refusal reason."""
+    from ..degrade import Admission
+
+    if grid.overlaps != (3, 3, 3):
+        return Admission.no(f"grid overlaps {grid.overlaps} != (3, 3, 3)")
+    if P.ndim != 3:
+        return Admission.no(f"pressure rank {P.ndim} != 3")
     s = tuple(grid.local_shape_any(P))
     if s != tuple(grid.nxyz):
-        return False
+        return Admission.no(f"staggered local shape {s} != grid block "
+                            f"{tuple(grid.nxyz)}")
     if not (s[0] % 8 == 0 and s[0] >= 16 and s[1] >= 8 and s[2] >= 8):
-        return False
-    return _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) >= 4
+        return Admission.no(f"local block {s} too small to slab "
+                            f"(needs x % 8 == 0, x >= 16, y >= 8, z >= 8)")
+    if _fit_bx(8, s[0], s[1], s[2], check_vmem=not interpret) < 4:
+        return Admission.no(f"no slab height bx >= 4 fits the VMEM budget "
+                            f"for local y*z area {s[1]}x{s[2]}")
+    return Admission.yes()
 
 
 def _win_x(P, Vx, Vy, Vz, Rho, scal, lo, hi):

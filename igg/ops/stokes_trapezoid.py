@@ -141,7 +141,7 @@ def _vmem_need(shape, K, modes, itemsize: int = 4) -> int:
 
 
 def stokes_trapezoid_supported(grid, shape, K: int, n_inner: int, dtype,
-                               interpret: bool = False) -> bool:
+                               interpret: bool = False):
     """Whether the K-iteration Stokes chunk tier applies: overlap-3 grid
     (the per-iteration kernel's prerequisite — it runs the warm-up and
     remainder iterations), at least one full chunk, `E = 2K` send slabs
@@ -149,33 +149,46 @@ def stokes_trapezoid_supported(grid, shape, K: int, n_inner: int, dtype,
     kernel's band/tile-alignment geometry, and the resident working set
     within the VMEM budget.  Both realizations take the same gates so
     interpret meshes exercise the compiled tier's exact admission
-    decisions (the `diffusion_trapezoid` convention)."""
+    decisions (the `diffusion_trapezoid` convention).  Returns an
+    :class:`igg.degrade.Admission` (truthy/falsy) carrying the structured
+    refusal reason."""
     import numpy as np
 
+    from ..degrade import Admission
+
     if K < 2 or n_inner < K:
-        return False
-    if grid.overlaps != (3, 3, 3) or tuple(shape) != tuple(grid.nxyz):
-        return False
+        return Admission.no(f"n_inner={n_inner} holds no full K={K} chunk "
+                            f"(needs n_inner >= K >= 2)")
+    if grid.overlaps != (3, 3, 3):
+        return Admission.no(f"grid overlaps {grid.overlaps} != (3, 3, 3)")
+    if tuple(shape) != tuple(grid.nxyz):
+        return Admission.no(f"local shape {tuple(shape)} != grid block "
+                            f"{tuple(grid.nxyz)}")
     if getattr(grid, "disp", 1) != 1:
         # The chunked slab exchange hardwires +-1 ppermute tables.
-        return False
+        return Admission.no(f"grid disp {grid.disp} != 1 (chunk slab "
+                            f"exchange hardwires +-1 ppermute tables)")
     if np.dtype(dtype) != np.float32:
-        return False
+        return Admission.no(f"dtype {np.dtype(dtype)} is not float32")
     modes = _dim_modes(grid)
     E = 2 * K
     S0, S1, S2 = shape
     if S0 % _BX != 0 or S0 < 2 * _BX:
-        return False
+        return Admission.no(f"x extent {S0} not band-divisible "
+                            f"(needs S0 % {_BX} == 0, S0 >= {2 * _BX})")
     if S1 % 8 != 0 or S2 % 128 != 0:
         # Mosaic tile-aligned leading-dim VMEM slices (staggered trailing
         # extents are padded by the kernel; the base extents must align).
-        return False
+        return Admission.no(f"local y/z extents ({S1}, {S2}) not Mosaic "
+                            f"tile-aligned (y % 8, z % 128)")
     if modes[0] != "frozen" and (2 * E) % _BX != 0:
         # S0e = S0 + 2E must stay band-divisible.
-        return False
+        return Admission.no(f"extended x span S0 + {2 * E} not "
+                            f"band-divisible by {_BX}")
     if modes[1] in ("ext", "oext") and E % 8 != 0:
         # Central y window slice offset must stay on sublane tiles.
-        return False
+        return Admission.no(f"y-extension E={E} not on sublane tiles "
+                            f"(E % 8 != 0)")
     shapes = _field_shapes(shape)
     ols = _ols(grid, shapes)
     for d in range(3):
@@ -183,8 +196,15 @@ def stokes_trapezoid_supported(grid, shape, K: int, n_inner: int, dtype,
             continue
         for s, ol in zip(shapes, ols):
             if s[d] - ol[d] - E < 0 or ol[d] + E > s[d]:
-                return False            # K-deep send slabs inside the block
-    return _vmem_need(shape, K, modes) <= _VMEM_BUDGET
+                # K-deep send slabs inside the block
+                return Admission.no(
+                    f"E={E} dim-{d} send slabs fall outside a field block "
+                    f"(shape {s}, ol {ol[d]})")
+    need = _vmem_need(shape, K, modes)
+    if need > _VMEM_BUDGET:
+        return Admission.no(f"resident working set {need} bytes exceeds "
+                            f"the VMEM budget {_VMEM_BUDGET}")
+    return Admission.yes()
 
 
 def fit_stokes_K(grid, shape, n_inner: int, dtype,

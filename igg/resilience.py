@@ -88,7 +88,15 @@ __all__ = ["run_resilient", "RunResult", "Event", "ResilienceError",
 
 class ResilienceError(GridError):
     """Unrecoverable failure of the resilient loop: retry budget exhausted,
-    or no healthy checkpoint generation to roll back to."""
+    or no healthy checkpoint generation to roll back to.  Carries the run's
+    event history up to the failure as `.events` (the same
+    :class:`Event` list a successful run returns in `RunResult.events`),
+    so a postmortem sees every detection, rollback, and degradation that
+    led here — not just the final message."""
+
+    def __init__(self, message: str, events: Sequence["Event"] = ()):
+        super().__init__(message)
+        self.events: List[Event] = list(events)
 
 
 # Process-wide preemption flag.  threading.Event so a SIGTERM delivered on
@@ -119,7 +127,10 @@ class Event:
     the generation was committed by the async writer), 'checkpoint_failed'
     (a background write failed — one generation of ring depth lost),
     'nan_detected', 'divergence',
-    'rollback', 'preempt', or a chaos injector's 'chaos_*'; `step` is the
+    'rollback', 'tier_degraded' (the recovery ladder demoted the kernel
+    tier that served the failing dispatch — a recurrence at the same step
+    is the signature of a deterministic kernel blowup; detail: tier,
+    reason), 'preempt', or a chaos injector's 'chaos_*'; `step` is the
     step count the event is anchored to (for 'nan_detected' the PROBE step
     — injection happened inside that watch window); `detail` carries
     kind-specific payload (per-field counts, paths, ...)."""
@@ -404,6 +415,12 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     probe = _make_probe() if (watch and watch_every) else None
     pending: deque = deque()   # (probe_step, device-resident (nf,) counts)
     retries = 0
+    last_fail = None           # (kind, step) of the previous rollback cause
+    # Demotion scope: only ladder families that dispatch AFTER this stamp
+    # belong to this run — a healthy tier some unrelated earlier factory
+    # warmed must never be quarantined by this run's recovery.
+    from . import degrade as _degrade
+    run_stamp = _degrade.dispatch_stamp()
     preempted = False
     last_ckpt: Optional[pathlib.Path] = None
     last_ckpt_step = -1
@@ -529,20 +546,43 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
 
     def _rollback(ev: Event) -> None:
         nonlocal state, steps_done, retries, step_fn, final_probe_done, \
-            last_good, last_ckpt, last_ckpt_step
+            last_good, last_ckpt, last_ckpt_step, last_fail
+        from . import degrade
+
         final_probe_done = False   # the replay's tail window re-probes
-        retries += 1
+        # Tier-demotion rung (igg.degrade): the SAME failure recurring at
+        # the SAME step after a bit-exact rollback is the signature of a
+        # deterministic kernel blowup (a miscompiled fast tier), not a
+        # transient — damping dt or replaying cannot fix it.  Quarantine
+        # the tier(s) that served the failing dispatch so the replay runs
+        # the next rung, and do NOT burn a retry on it: the demotion IS
+        # the recovery action (each tier demotes at most once, so this
+        # cannot loop).  First occurrences and recurrences with no fast
+        # tier left fall through to the plain retry budget.
+        demoted: List[str] = []
+        if last_fail == (ev.kind, ev.step):
+            demoted = degrade.demote_active(
+                reason="nan_recurrence",
+                error_text=f"{ev.kind} recurred at step {ev.step} after a "
+                           f"bit-exact rollback",
+                since=run_stamp)
+            for tname in demoted:
+                _emit("tier_degraded", ev.step, tier=tname,
+                      reason="nan_recurrence")
+        last_fail = (ev.kind, ev.step)
+        if not demoted:
+            retries += 1
         if retries > max_retries:
             raise ResilienceError(
                 f"run_resilient: {ev.kind} at step {ev.step} "
                 f"({ev.detail or ''}) and the retry budget "
-                f"(max_retries={max_retries}) is exhausted.")
+                f"(max_retries={max_retries}) is exhausted.", events)
         if cdir is None:
             raise ResilienceError(
                 f"run_resilient: {ev.kind} at step {ev.step} but no "
                 f"checkpoint_dir is configured — nothing to roll back to.  "
                 f"Enable the ring (checkpoint_every/checkpoint_dir) for "
-                f"rollback-and-retry.")
+                f"rollback-and-retry.", events)
         # The generation scan must see every in-flight background write
         # settled (committed or failed) — a half-staged directory is not a
         # rollback candidate, and the newest healthy generation may still
@@ -570,7 +610,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             raise ResilienceError(
                 f"run_resilient: {ev.kind} at step {ev.step} and no healthy "
                 f"checkpoint generation exists under {cdir} to roll back "
-                f"to.")
+                f"to.", events)
         pending.clear()
         state = ckpt.load_checkpoint(target[1])
         steps_done = target[0]

@@ -1,0 +1,493 @@
+"""The verified tier-degradation ladder (`igg.degrade`, round 10): kernel
+quarantine with compile-failure capture, numeric verify-on-first-use
+against the pure-XLA composition truth, the chaos injectors that prove
+both guards on the 8-device CPU mesh, and the `run_resilient` recovery
+rung that demotes a deterministically-blowing-up tier with zero
+user-supplied policy code.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import igg
+from igg import degrade
+from igg.models import diffusion3d, stokes3d
+
+
+PERIODIC = dict(periodx=1, periody=1, periodz=1)
+
+
+def _init_diffusion():
+    igg.init_global_grid(8, 8, 128, dimx=2, dimy=2, dimz=2, **PERIODIC,
+                         quiet=True)
+
+
+def _diffusion_state(params=None):
+    params = params or diffusion3d.Params()
+    return diffusion3d.init_fields(params)
+
+
+def _xla_reference(T, Cp, n=1, params=None):
+    params = params or diffusion3d.Params()
+    step = diffusion3d.make_step(params, use_pallas=False, donate=False)
+    for _ in range(n):
+        T = step(T, Cp)
+    return np.asarray(T)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ladder():
+    degrade.reset()
+    yield
+    degrade.reset()
+
+
+class TestAdmission:
+    def test_truthy_falsy_and_reason(self):
+        assert degrade.Admission.yes()
+        no = degrade.Admission.no("because")
+        assert not no
+        assert no.reason == "because"
+        assert "because" in repr(no)
+
+    def test_ops_gates_return_structured_reasons(self):
+        from igg.ops import pallas_supported, stokes_pallas_supported
+
+        _init_diffusion()
+        grid = igg.get_global_grid()
+        T, _ = _diffusion_state()
+        adm = pallas_supported(grid, T)
+        assert adm and isinstance(adm, degrade.Admission)
+        # Wrong overlap for the Stokes kernel: falsy with a named gate.
+        ref = stokes_pallas_supported(grid, T)
+        assert not ref
+        assert "overlaps" in ref.reason
+
+    def test_trapezoid_gate_reasons(self):
+        from igg.ops import stokes_trapezoid_supported
+        from igg.ops.diffusion_trapezoid import trapezoid_supported
+
+        _init_diffusion()
+        grid = igg.get_global_grid()
+        no_chunk = trapezoid_supported(grid, (8, 8, 128), 8, 2, np.float32)
+        assert not no_chunk and "chunk" in no_chunk.reason
+        bad = stokes_trapezoid_supported(grid, (8, 8, 128), 4, 8,
+                                         np.float32, interpret=True)
+        assert not bad and "overlaps" in bad.reason
+
+    def test_admission_log_records_refusals(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        # CPU mesh, interpret off: the mosaic rung refuses with a reason.
+        step = diffusion3d.make_step(donate=False)
+        step(T, Cp)
+        log = degrade.admission_log()
+        assert "not TPU" in log.get("diffusion3d.mosaic", "")
+        assert degrade.active().get("diffusion3d") == "diffusion3d.xla"
+
+
+class TestCompileFailureCapture:
+    def test_quarantine_and_bitexact_fallback(self):
+        """A chaos-forced Mosaic compile failure ends in a COMPLETED
+        dispatch bit-exact to the pure-XLA composition — no crash, no
+        wrong answer — with the tier quarantined and the error captured."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        ref = _xla_reference(T + 0, Cp)
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic",
+                                           "chaos: no Mosaic today"):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                out = step(T + 0, Cp)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        q = degrade.status()["diffusion3d.mosaic"]
+        assert q.reason == "compile_failed"
+        assert "chaos: no Mosaic today" in q.error
+        assert degrade.active()["diffusion3d"] == "diffusion3d.xla"
+        assert any("quarantined" in str(x.message) for x in w)
+        events = degrade.events()
+        assert events and events[-1]["kind"] == "tier_degraded"
+
+    def test_one_time_warning(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                step(T + 0, Cp)
+                step(T + 0, Cp)
+        msgs = [x for x in w if "quarantined" in str(x.message)]
+        assert len(msgs) == 1
+
+    def test_required_tier_raises(self):
+        """use_pallas=True keeps its contract: a required tier whose first
+        compile fails raises GridError instead of silently degrading."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            step = diffusion3d.make_step(use_pallas=True,
+                                         pallas_interpret=True, donate=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with pytest.raises(igg.GridError, match="required"):
+                    step(T + 0, Cp)
+        # ... and stays refused on the next dispatch, naming the capture.
+        step2 = diffusion3d.make_step(use_pallas=True,
+                                      pallas_interpret=True, donate=False)
+        with pytest.raises(igg.GridError, match="quarantined"):
+            step2(T + 0, Cp)
+
+    def test_reset_readmits(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step(T + 0, Cp)
+        assert degrade.is_quarantined("diffusion3d.mosaic")
+        degrade.reset("diffusion3d.mosaic")
+        assert not degrade.is_quarantined("diffusion3d.mosaic")
+        # Healthy again: a fresh factory serves the fast tier.
+        step2 = diffusion3d.make_step(pallas_interpret=True, donate=False)
+        step2(T + 0, Cp)
+        assert degrade.active()["diffusion3d"] == "diffusion3d.mosaic"
+
+
+class TestVerifyFirstUse:
+    def test_corrupt_kernel_never_serves_wrong_answer(self):
+        """A chaos-corrupted kernel output under verify="first_use" ends in
+        a COMPLETED dispatch bit-exact to the XLA composition: the
+        mismatch quarantines the tier BEFORE it serves traffic."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        ref = _xla_reference(T + 0, Cp)
+        with igg.chaos.kernel_corrupt("diffusion3d.mosaic", magnitude=1e3):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False,
+                                         verify="first_use")
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                out = step(T + 0, Cp)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        q = degrade.status()["diffusion3d.mosaic"]
+        assert q.reason == "verify_mismatch"
+        assert "beyond tolerance" in q.error
+        assert any("quarantined" in str(x.message) for x in w)
+
+    def test_healthy_tier_passes_verify_once(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        ref = _xla_reference(T + 0, Cp, n=2)
+        step = diffusion3d.make_step(pallas_interpret=True, donate=False,
+                                     verify="first_use")
+        out = step(step(T + 0, Cp), Cp)
+        assert degrade.status() == {}
+        assert degrade.active()["diffusion3d"] == "diffusion3d.mosaic"
+        # Interpret-mode Pallas matches the XLA composition bit-exactly on
+        # this stencil; the guard's tolerance gate never engaged.
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    def test_env_knob_enables_verify(self, monkeypatch):
+        monkeypatch.setenv("IGG_VERIFY_KERNELS", "1")
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        with igg.chaos.kernel_corrupt("diffusion3d.mosaic", magnitude=1e3):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step(T + 0, Cp)
+        assert (degrade.status()["diffusion3d.mosaic"].reason
+                == "verify_mismatch")
+
+    def test_verify_mode_validated(self):
+        _init_diffusion()
+        with pytest.raises(igg.GridError, match="verify"):
+            diffusion3d.make_step(verify="always")
+
+
+class TestStokesLadder:
+    def test_multi_rung_fall(self):
+        """Both fast Stokes rungs chaos-quarantined: trapezoid falls to the
+        per-iteration mosaic rung, mosaic falls to the XLA truth, and the
+        result is bit-exact to the pure composition."""
+        igg.init_global_grid(16, 16, 128, dimx=2, dimy=2, dimz=2, **PERIODIC,
+                             overlapx=3, overlapy=3, overlapz=3, quiet=True)
+        params = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+        P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float32)
+        ref_it = stokes3d.make_iteration(params, donate=False,
+                                         use_pallas=False, n_inner=5)
+        ref = [np.asarray(a) for a in ref_it(P, Vx, Vy, Vz, Rho)]
+        with igg.chaos.armed(
+                igg.chaos.kernel_compile_fail("stokes3d.trapezoid"),
+                igg.chaos.kernel_compile_fail("stokes3d.mosaic")):
+            it = stokes3d.make_iteration(params, donate=False, n_inner=5,
+                                         pallas_interpret=True)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                out = it(P, Vx, Vy, Vz, Rho)
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        assert set(degrade.status()) == {"stokes3d.trapezoid",
+                                         "stokes3d.mosaic"}
+        assert degrade.active()["stokes3d"] == "stokes3d.xla"
+        assert len([x for x in w if "quarantined" in str(x.message)]) == 2
+
+    def test_trapezoid_rung_admitted_and_healthy(self):
+        igg.init_global_grid(16, 16, 128, dimx=2, dimy=2, dimz=2, **PERIODIC,
+                             overlapx=3, overlapy=3, overlapz=3, quiet=True)
+        params = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+        P, Vx, Vy, Vz, Rho = stokes3d.init_fields(params, dtype=np.float32)
+        it = stokes3d.make_iteration(params, donate=False, n_inner=5,
+                                     pallas_interpret=True)
+        it(P, Vx, Vy, Vz, Rho)
+        assert degrade.active()["stokes3d"] == "stokes3d.trapezoid"
+        assert degrade.status() == {}
+
+
+class TestDemoteActive:
+    def test_demotes_fast_tier_not_truth(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+        step(T + 0, Cp)
+        assert degrade.active()["diffusion3d"] == "diffusion3d.mosaic"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            demoted = degrade.demote_active(error_text="test recurrence")
+        assert demoted == ["diffusion3d.mosaic"]
+        assert (degrade.status()["diffusion3d.mosaic"].reason
+                == "nan_recurrence")
+        # Nothing left to demote: the truth rung serves now.
+        step2 = diffusion3d.make_step(pallas_interpret=True, donate=False)
+        step2(T + 0, Cp)
+        assert degrade.demote_active() == []
+
+    def test_since_scopes_demotion_to_the_run(self):
+        """A family warmed BEFORE the failing run must not be demoted by
+        that run's recovery (`demote_active(since=stamp)`)."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+        step(T + 0, Cp)               # warmed before the "run" starts
+        mark = degrade.dispatch_stamp()
+        assert degrade.demote_active(since=mark) == []
+        assert not degrade.is_quarantined("diffusion3d.mosaic")
+        step(T + 0, Cp)               # dispatched inside the "run"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert degrade.demote_active(since=mark) == \
+                ["diffusion3d.mosaic"]
+
+    def test_served_memory_survives_factory_recreation(self):
+        """Once a tier has served, a RECREATED factory's first-dispatch
+        failure is a real error (propagates), not a compile failure to
+        quarantine — the served memory is process-wide like quarantine."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+        step(T + 0, Cp)               # the tier has served
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            fresh = diffusion3d.make_step(pallas_interpret=True,
+                                          donate=False)
+            with pytest.raises(degrade.InjectedCompileError):
+                fresh(T + 0, Cp)
+        assert not degrade.is_quarantined("diffusion3d.mosaic")
+
+
+class TestResilientTierDemotion:
+    def test_recovery_with_zero_policy_code(self, tmp_path):
+        """A chaos-corrupted kernel (NaN every dispatch — rollback cannot
+        heal it) recovers via tier demotion within the default retry
+        budget, bit-exact to the pure-XLA run, with NO recovery_policy."""
+        _init_diffusion()
+        params = diffusion3d.Params()
+        T, Cp = _diffusion_state(params)
+        ref_step = diffusion3d.make_step(params, use_pallas=False,
+                                         donate=False)
+        ref = {"T": T + 0}
+        for _ in range(20):
+            ref["T"] = ref_step(ref["T"], Cp)
+        step = diffusion3d.make_step(params, pallas_interpret=True,
+                                     donate=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with igg.chaos.kernel_corrupt("diffusion3d.mosaic"):
+                res = igg.run_resilient(
+                    lambda s: {"T": step(s["T"], Cp)}, {"T": T + 0}, 20,
+                    watch_every=5, checkpoint_dir=tmp_path,
+                    checkpoint_every=5, async_checkpoint=False)
+        assert res.steps_done == 20
+        assert res.retries <= 3   # within the default budget
+        kinds = [e.kind for e in res.events]
+        assert "tier_degraded" in kinds
+        deg = next(e for e in res.events if e.kind == "tier_degraded")
+        assert deg.detail["tier"] == "diffusion3d.mosaic"
+        assert degrade.is_quarantined("diffusion3d.mosaic")
+        np.testing.assert_array_equal(np.asarray(res.state["T"]),
+                                      np.asarray(ref["T"]))
+
+    def test_resilience_error_carries_events(self, tmp_path):
+        """Exhaustion hands the postmortem the full event history."""
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        step = diffusion3d.make_step(use_pallas=False, donate=False)
+        plan = igg.chaos.ChaosPlan(nan_at=[(4, "T"), (9, "T"), (14, "T")])
+        with pytest.raises(igg.ResilienceError) as ei:
+            igg.run_resilient(
+                lambda s: {"T": step(s["T"], Cp)}, {"T": T + 0}, 20,
+                watch_every=5, checkpoint_dir=tmp_path, checkpoint_every=5,
+                async_checkpoint=False, max_retries=1, chaos=plan)
+        evs = ei.value.events
+        assert [e.kind for e in evs].count("nan_detected") >= 2
+        assert any(e.kind == "rollback" for e in evs)
+
+
+class TestHaloWriterTier:
+    def test_quarantine_disables_writer_election(self):
+        from igg import halo
+
+        igg.init_global_grid(8, 16, 256, **PERIODIC, quiet=True)
+        A = igg.zeros((8, 16, 256), dtype=np.float32)
+        halo._FORCE_WRITER_INTERPRET = True
+        try:
+            grid = igg.get_global_grid()
+            dims = halo.moving_dims(halo.active_dims(A.shape, grid), grid)
+            _, use_writer = halo._writer_dims(A, dims, grid)
+            assert use_writer
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                degrade.quarantine(degrade.HALO_WRITER_TIER, 0,
+                                   "compile_failed",
+                                   error_text="test injection")
+            _, use_writer = halo._writer_dims(A, dims, grid)
+            assert not use_writer
+            # The forced-writer contract names the quarantine.
+            with pytest.raises(igg.GridError, match="quarantined"):
+                igg.update_halo(A, assembly="pallas")
+        finally:
+            halo._FORCE_WRITER_INTERPRET = False
+
+    def test_compile_fail_capture_falls_to_xla(self):
+        """Chaos-injected writer compile failure: update_halo completes on
+        the XLA plans, the tier is quarantined, the answer is the oracle's."""
+        from helpers import roundtrip
+
+        from igg import halo
+
+        igg.init_global_grid(8, 16, 256, **PERIODIC, quiet=True)
+        halo._FORCE_WRITER_INTERPRET = True
+        try:
+            with igg.chaos.kernel_compile_fail(degrade.HALO_WRITER_TIER):
+                with warnings.catch_warnings(record=True) as w:
+                    warnings.simplefilter("always")
+                    out, exp = roundtrip((8, 16, 256), dtype=np.float32)
+            np.testing.assert_array_equal(out, exp.astype(np.float32))
+            q = degrade.status()[degrade.HALO_WRITER_TIER]
+            assert q.reason == "compile_failed"
+            assert any("quarantined" in str(x.message) for x in w)
+        finally:
+            halo._FORCE_WRITER_INTERPRET = False
+
+
+class TestChaosContextManagers:
+    def test_armed_disarms_on_exception(self):
+        kc = igg.chaos.kernel_corrupt("some.tier", 1.0)
+        with pytest.raises(RuntimeError, match="boom"):
+            with igg.chaos.armed(kc):
+                assert degrade._CHAOS_TIER_TAP is not None
+                raise RuntimeError("boom")
+        assert degrade._CHAOS_TIER_TAP is None
+
+    def test_armed_resets_chaos_plan(self):
+        plan = igg.chaos.ChaosPlan(nan_at=[(3, "T")])
+        plan._fired.add(("nan", 3, "T", None))
+        with igg.chaos.armed(plan) as p:
+            assert p is plan
+            assert not plan._fired   # re-armed on entry
+            plan._fired.add(("nan", 3, "T", None))
+        assert not plan._fired       # consumed state cannot leak
+
+    def test_stacked_injectors_unwind(self):
+        a = igg.chaos.kernel_compile_fail("t.a")
+        b = igg.chaos.kernel_corrupt("t.b", 2.0)
+        with igg.chaos.armed(a, b) as (ia, ib):
+            tap = degrade._CHAOS_TIER_TAP
+            assert tap["compile_fail"]["t.a"] is None
+            assert tap["corrupt"]["t.b"] == 2.0
+        assert degrade._CHAOS_TIER_TAP is None
+
+    def test_imperative_wrappers_still_work(self):
+        kc = igg.chaos.kernel_compile_fail("t.c").arm()
+        assert "t.c" in degrade._CHAOS_TIER_TAP["compile_fail"]
+        kc.disarm()
+        assert degrade._CHAOS_TIER_TAP is None
+
+
+class TestEnvRegistry:
+    def test_unknown_igg_var_warns_once(self, monkeypatch):
+        from igg import _env
+
+        monkeypatch.setenv("IGG_VERIFY_KERNEL", "1")   # typo'd knob
+        monkeypatch.setattr(_env, "_warned_unknown", False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _env.flag("IGG_VERIFY_KERNELS")
+            _env.flag("IGG_VERIFY_KERNELS")
+        msgs = [x for x in w if "IGG_VERIFY_KERNEL" in str(x.message)]
+        assert len(msgs) == 1
+        assert "no effect" in str(msgs[0].message)
+
+    def test_typed_accessors_raise_grid_error(self, monkeypatch):
+        from igg import _env
+
+        monkeypatch.setattr(_env, "_warned_unknown", True)
+        monkeypatch.setenv("IGG_CKPT_COMMIT_TIMEOUT", "ten")
+        with pytest.raises(igg.GridError, match="IGG_CKPT_COMMIT_TIMEOUT"):
+            _env.number("IGG_CKPT_COMMIT_TIMEOUT", 600)
+        monkeypatch.setenv("IGG_VERIFY_KERNELS", "maybe")
+        with pytest.raises(igg.GridError, match="boolean"):
+            _env.flag("IGG_VERIFY_KERNELS")
+
+    def test_flag_spellings(self, monkeypatch):
+        from igg import _env
+
+        monkeypatch.setattr(_env, "_warned_unknown", True)
+        for val, want in [("1", True), ("true", True), ("ON", True),
+                          ("0", False), ("off", False), ("", False)]:
+            monkeypatch.setenv("IGG_VERIFY_KERNELS", val)
+            assert _env.flag("IGG_VERIFY_KERNELS") is want
+
+    def test_register_extends_registry(self, monkeypatch):
+        from igg import _env
+
+        monkeypatch.setattr(_env, "_KNOWN", dict(_env._KNOWN))
+        _env.register("IGG_TEST_KNOB", "test-only")
+        monkeypatch.setenv("IGG_TEST_KNOB", "7")
+        assert _env.integer("IGG_TEST_KNOB", 0) == 7
+        with pytest.raises(igg.GridError, match="IGG_"):
+            _env.register("NOT_IGG", "nope")
+
+
+class TestLifecycle:
+    def test_finalize_clears_ladder_state(self):
+        _init_diffusion()
+        T, Cp = _diffusion_state()
+        with igg.chaos.kernel_compile_fail("diffusion3d.mosaic"):
+            step = diffusion3d.make_step(pallas_interpret=True, donate=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step(T + 0, Cp)
+        assert degrade.status()
+        igg.finalize_global_grid()
+        assert degrade.status() == {}
+        assert degrade.events() == []
+        assert degrade.active() == {}
+
+    def test_ladder_requires_truth_rung(self):
+        with pytest.raises(igg.GridError, match="truth"):
+            degrade.Ladder("fam", [degrade.Tier(name="fam.fast", rung=0,
+                                                build=lambda: None)])

@@ -79,10 +79,15 @@ class TestEndToEnd3D:
         np.testing.assert_array_equal(out, exp)
 
     def test_staggered_arrays(self):
+        from helpers import assert_halo_agreement
+
         igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
         for lshape in [(7, 6, 6), (6, 7, 6), (6, 6, 7)]:  # Vx, Vy, Vz
             out, exp = roundtrip(lshape)
             np.testing.assert_array_equal(out, exp)
+            # The post-exchange invariant the degrade verify guard leans
+            # on: every overlap cell equals the owning neighbor's interior.
+            assert_halo_agreement(out, lshape)
 
     def test_larger_overlap(self):
         igg.init_global_grid(8, 8, 8, overlapx=3, overlapz=4, **PERIODIC,
@@ -256,19 +261,29 @@ class TestEndToEnd4D:
     (`/root/reference/src/shared.jl:32`)."""
 
     def test_periodic_multidevice(self):
+        from helpers import assert_halo_agreement
+
         igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
         out, exp = roundtrip((6, 6, 6, 3))
         np.testing.assert_array_equal(out, exp)
+        assert_halo_agreement(out, (6, 6, 6, 3))
 
     def test_open_boundaries(self):
+        from helpers import assert_halo_agreement
+
         igg.init_global_grid(6, 6, 6, quiet=True)
         out, exp = roundtrip((6, 6, 6, 3))
         np.testing.assert_array_equal(out, exp)
+        # Open dims have no wrap pair; interior-pair overlap still agrees.
+        assert_halo_agreement(out, (6, 6, 6, 3))
 
     def test_staggered_rank4(self):
+        from helpers import assert_halo_agreement
+
         igg.init_global_grid(6, 6, 6, **PERIODIC, quiet=True)
         out, exp = roundtrip((7, 6, 6, 2))   # x-staggered component field
         np.testing.assert_array_equal(out, exp)
+        assert_halo_agreement(out, (7, 6, 6, 2))
 
     def test_grouped_mixed_rank(self):
         """One grouped update mixing a rank-3 and a rank-4 field (the
