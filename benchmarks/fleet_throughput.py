@@ -1,0 +1,162 @@
+"""Fleet throughput — the jobs/hour headline of the ensemble/fleet tier.
+
+A *job* is the fleet scheduler's unit of work: a config (global domain,
+member count, step count) drained from the queue onto whatever devices
+exist, run as ONE compiled vmapped ensemble program with the per-member
+watchdog armed and the sharded checkpoint ring on (`igg.run_fleet` →
+`igg.run_ensemble` — everything a production sweep would run with).  The
+headline is end-to-end **jobs/hour** including every per-job cost the
+scheduler owns: decomposition planning, grid init, state build, program
+compile, the run itself, ring writes, and journal updates.
+
+Two supporting columns quantify where the tier earns its keep:
+
+- `member_steps_per_s` — total member-steps per wall second
+  (jobs * members * steps / wall): the packing throughput number that
+  scales with M while the grid is underutilized.
+- `overhead_pct` — scheduler + resilience overhead vs a bare back-to-back
+  loop of the SAME physics (one compiled vmapped dispatch loop per job,
+  no scheduler, no watchdog, no ring, no journal).  Informational on the
+  shared CI host (wall-clock noise floor, cf. benchmarks/README.md); the
+  watchdog component has its own asserted contract in
+  `resilience_overhead.py` (`ensemble_overhead` row).
+
+The smoke contract (asserted, `"pass"`): every submitted job completes
+(`done`, zero quarantined members — the chaos-free queue must be
+loss-free) and the jobs/hour figure is finite and positive.  `ci.sh`
+asserts the row on every run; `run_all.py --quick` emits it on the CPU
+mesh (stamped smoke=true — program structure, not TPU performance).
+
+Usage: `python benchmarks/fleet_throughput.py [G] [jobs] [members] [steps]`
+(default 28 4 4 40: four 4-member jobs of a (G, G, G)-interior diffusion
+ensemble, 40 steps each).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from common import emit, note
+
+
+def _member_states(job_index, members):
+    """The flagship diffusion family as ensemble members: coordinate-built
+    fields (decomposition-invariant) with a per-member `dt_scale` sweep —
+    what a production parameter sweep actually runs.  Job index offsets
+    the sweep so jobs differ."""
+    def build(grid):
+        from igg.models import diffusion3d as d3
+
+        T, Cp = d3.init_fields(d3.Params(), dtype=np.float32)
+        return [{"T": T, "Cp": Cp,
+                 "dt_scale": np.float32(1.0 - 0.02 * (job_index + m))}
+                for m in range(members)]
+    return build
+
+
+def _member_step(grid):
+    # Built per launch (the Job.make_step hook): the model's spacing/dt
+    # constants read the live grid.
+    from igg.models import diffusion3d as d3
+
+    return d3.make_member_step(d3.Params())
+
+
+def main():
+    G = int(sys.argv[1]) if len(sys.argv) > 1 else 28
+    n_jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    members = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    steps = int(sys.argv[4]) if len(sys.argv) > 4 else 40
+
+    import pathlib
+    import shutil
+    import tempfile
+
+    import jax
+
+    import igg
+    from igg import ensemble as ens
+    from igg.fleet import plan_dims
+
+    platform = jax.devices()[0].platform
+    ndev = len(jax.devices())
+    note(f"platform={platform} devices={ndev} interior={G}^3 "
+         f"jobs={n_jobs} members={members} steps={steps}")
+
+    jobs = [igg.Job(name=f"sweep-{i:02d}", global_interior=(G, G, G),
+                    members=members, n_steps=steps,
+                    make_states=_member_states(i, members),
+                    make_step=_member_step, watch_every=10,
+                    checkpoint_every=max(10, steps // 2), ring=2)
+            for i in range(n_jobs)]
+
+    wd = pathlib.Path(tempfile.mkdtemp(prefix="igg_fleet_bench_"))
+    try:
+        t0 = time.monotonic()
+        res = igg.run_fleet(jobs, wd, install_sigterm=False)
+        wall = time.monotonic() - t0
+
+        done = sum(1 for o in res.jobs.values() if o.status == "done")
+        quarantined = sum(len(o.result.quarantined)
+                          for o in res.jobs.values()
+                          if o.result is not None)
+        jobs_per_hour = done / wall * 3600.0
+        member_steps_per_s = done * members * steps / wall
+
+        # Bare back-to-back baseline: same physics, one compiled vmapped
+        # dispatch loop per job — no scheduler, watchdog, ring, journal.
+        dims, local = plan_dims((G, G, G), ndev)
+        t0 = time.monotonic()
+        for i in range(n_jobs):
+            igg.init_global_grid(
+                *local, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                periodx=1, periody=1, periodz=1, quiet=True,
+                devices=jax.devices()[:int(np.prod(dims))])
+            grid = igg.get_global_grid()
+            state = ens.stack_members(
+                _member_states(i, members)(grid))
+            pk = ens._choose_packing(grid, members, "auto", None)
+            state = pk.put_state(state)
+            keys = sorted(state)
+            nd = {k: int(np.ndim(state[k])) for k in keys}
+            estep = ens._build_step(_member_step(grid), pk, keys, nd, 1)
+            mask = pk.put_mask(np.ones(members, dtype=bool))
+            for _ in range(steps):
+                state = estep(state, mask)
+            jax.block_until_ready(state["T"])
+            igg.finalize_global_grid()
+        bare_wall = time.monotonic() - t0
+        overhead_pct = (wall - bare_wall) / bare_wall * 100.0
+
+        emit({
+            "metric": "fleet_throughput",
+            "value": round(jobs_per_hour, 2),
+            "unit": "jobs/hour",
+            "config": {"interior": G, "jobs": n_jobs, "members": members,
+                       "steps": steps, "devices": ndev,
+                       "dims": list(dims), "platform": platform},
+            "wall_s": round(wall, 3),
+            "bare_wall_s": round(bare_wall, 3),
+            "member_steps_per_s": round(member_steps_per_s, 1),
+            "overhead_pct": round(overhead_pct, 1),
+            "jobs_done": done,
+            "members_quarantined": quarantined,
+            "pass": bool(done == n_jobs and quarantined == 0
+                         and np.isfinite(jobs_per_hour)
+                         and jobs_per_hour > 0),
+            "contract": "every submitted job completes with zero "
+                        "quarantined members on the chaos-free queue; "
+                        "jobs/hour is the end-to-end headline (planning, "
+                        "grid init, compile, run, ring, journal "
+                        "included); overhead_pct vs the bare back-to-back "
+                        "loop is informational on shared hosts",
+        })
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

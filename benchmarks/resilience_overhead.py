@@ -57,8 +57,18 @@ tier is the real Mosaic kernel on TPU and the interpret-mode realization
 on CPU (at a small admissible shape — interpret dispatch cost scales with
 the same shape the denominator uses, so the ratio stays meaningful).
 
-Emits three JSON lines; the CPU run is the always-present smoke row
-(`ci.sh` asserts presence AND `"pass": true` of all three).  Usage:
+A fourth row measures the **per-member ensemble watchdog** (round 11):
+`igg.run_ensemble`'s probe computes each watched field's non-finite count
+reduced over GRID axes only — an (n_fields, M) matrix attributing a
+blowup to its member — dispatched once per watch window against the bare
+vmapped member loop.  Same methodology as row 1 (batch-amortized probe
+device cost divided by the watch window's step cost, here the cost of one
+vmapped M-member dispatch window).  Contract (asserted): the per-member
+watchdog keeps the PR-3 bound — **< 2%** over the bare vmapped loop at
+`watch_every=50`.
+
+Emits four JSON lines; the CPU run is the always-present smoke row
+(`ci.sh` asserts presence AND `"pass": true` of all four).  Usage:
 `python benchmarks/resilience_overhead.py [n] [nt]` (default 128 300).
 """
 
@@ -276,6 +286,74 @@ def main():
                     "(one tier dispatch + one truth dispatch per tier/"
                     "signature) amortizes to < 1% of a 1000-step run on "
                     "the serving tier",
+    })
+    igg.finalize_global_grid()
+
+    # ---- ensemble per-member watchdog vs the bare vmapped loop ----
+    # The component measurement of row 1 applied to the ensemble tier:
+    # the per-member probe (one read pass per watched field, counts
+    # reduced over grid axes only) dispatched once per watch window,
+    # divided by the window's cost on the bare vmapped M-member step.
+    from igg import ensemble as ens
+
+    M = 4
+    ne = min(n, 64)   # M members of ne^3/device: same-order footprint as
+    #                   row 1's single 128^3 member on the smoke host
+    igg.init_global_grid(ne, ne, ne, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+    T0e, Cpe = d3.init_fields(params, dtype=np.float32)
+    member = d3.make_member_step(params)
+    states = [{"T": T0e, "Cp": Cpe} for _ in range(M)]
+    pk = ens._choose_packing(grid, M, "auto", None)
+    state = pk.put_state(ens.stack_members(states))
+    keys = sorted(state)
+    nd = {k: int(np.ndim(state[k])) for k in keys}
+    estep = ens._build_step(member, pk, keys, nd, 1)
+    eprobe = ens._build_probe(pk, ["T"], nd)
+    mask = pk.put_mask(np.ones(M, dtype=bool))
+
+    state = estep(state, mask)                      # compile + warm
+    jax.block_until_ready(state["T"])
+    np.asarray(eprobe(state["T"]))                  # compile the probe
+
+    nt_e = max(10, nt // 10)
+    bare_ts = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        s = state
+        for _ in range(nt_e):
+            s = estep(s, mask)
+        jax.block_until_ready(s["T"])
+        bare_ts.append((time.monotonic() - t0) / nt_e)
+    bare_vstep_s = min(bare_ts)
+
+    probe_ts = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        for _ in range(10):
+            c = eprobe(state["T"])
+        jax.block_until_ready(c)
+        probe_ts.append((time.monotonic() - t0) / 10)
+    eprobe_s = min(probe_ts)
+
+    ens_overhead_pct = eprobe_s / (watch_every * bare_vstep_s) * 100.0
+    emit({
+        "metric": "ensemble_overhead",
+        "value": round(ens_overhead_pct, 3),
+        "unit": "%",
+        "config": {"local": ne, "members": M, "watch_every": watch_every,
+                   "devices": grid.nprocs, "dims": list(grid.dims),
+                   "packing": pk.name, "platform": platform,
+                   "nt": nt_e},
+        "bare_vstep_s": round(bare_vstep_s, 6),
+        "probe_s": round(eprobe_s, 6),
+        "pass": bool(ens_overhead_pct < 2.0),
+        "contract": "the per-member watchdog (counts reduced over grid "
+                    "axes only, one (n_fields, M) probe per watch window) "
+                    "adds < 2% over the bare vmapped member loop at "
+                    "watch_every=50 — the PR-3 overhead contract held at "
+                    "the ensemble tier",
     })
     igg.finalize_global_grid()
 

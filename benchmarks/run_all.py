@@ -98,6 +98,11 @@ def main():
     # smoke row's "pass": true).
     r("resilience_overhead.py", [] if not quick else [128, 100],
       tag="resilience_overhead")
+    # Fleet throughput (round 11): the ensemble/fleet tier's jobs/hour
+    # headline — end-to-end scheduler cost included; the smoke contract
+    # (every job done, zero quarantines) is asserted by ci.sh.
+    r("fleet_throughput.py", [] if not quick else [20, 2, 2, 20],
+      tag="fleet_throughput")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).  64^3 for
     # weak scaling = compute-dominated (see benchmarks/README.md for how to

@@ -1,0 +1,159 @@
+"""The ensemble/fleet tier end to end: member NaN → isolated per-member
+recovery → job preemption → queue journal → elastic resume on DIFFERENT
+capacity — bit-identical to an uninterrupted run.
+
+What `igg.run_fleet` + `igg.run_ensemble` give a parameter-sweep driver,
+demonstrated with the deterministic fleet/member chaos injectors (the
+same harness `tests/test_fleet.py` / `tests/test_ensemble.py` drive):
+
+1. a queue of three diffusion ensemble jobs (4 members each, swept
+   initial conditions) drains onto the 8-device mesh; job "sweep-01"
+   carries a member-targeted NaN injection — the per-member watchdog
+   attributes the blowup to member 2 ON DEVICE, rolls back ONLY that
+   member's checkpoint lane, and replays it under the validity mask
+   (healthy members replay nothing), so the job still completes with
+   zero quarantined members;
+2. `igg.chaos.job_preempt_at` "preempts" job "sweep-02" mid-run: the job
+   writes its final sharded generation, the queue journal records
+   `preempted`, and the fleet stops draining;
+3. a relaunched `run_fleet(..., resume=True)` on FOUR devices (half the
+   capacity died) re-admits the queue: done jobs are skipped, the
+   preempted job re-plans its decomposition onto the 4-device mesh and
+   resumes elastically (`load_checkpoint(redistribute=True)`), and the
+   final interiors are BIT-IDENTICAL to an uninterrupted 8-device run —
+   asserted at the end.
+
+Run on TPU or the virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/fleet_run.py
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg.ops import interior_add
+
+
+def member_step(st):
+    T = st["T"]
+    lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+           + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+           + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+           - 6.0 * T[1:-1, 1:-1, 1:-1])
+    return {"T": igg.update_halo_local(interior_add(T, 0.1 * lap))}
+
+
+def make_states(seed, members):
+    """Member states from a decomposition-INVARIANT global random field
+    (wrap-indexed per block), so the elastic-resume comparison is exact."""
+    def build(grid):
+        rng = np.random.default_rng(seed)
+        g = [grid.dims[d] * (grid.nxyz[d] - grid.overlaps[d])
+             for d in range(3)]
+        out = []
+        for _ in range(members):
+            glob = rng.standard_normal(g)
+
+            def block(coords, ls, glob=glob):
+                idx = [(coords[d] * (ls[d] - grid.overlaps[d])
+                        + np.arange(ls[d])) % g[d] for d in range(3)]
+                return glob[np.ix_(*idx)]
+
+            T = igg.from_local_blocks(block, tuple(grid.nxyz))
+            out.append({"T": igg.update_halo(T)})
+        return out
+    return build
+
+
+def _jobs(nan_member=True):
+    jobs = []
+    for i in range(3):
+        chaos = None
+        if nan_member and i == 1:
+            chaos = igg.chaos.ChaosPlan(nan_at=[(7, 2, "T")])
+        jobs.append(igg.Job(
+            name=f"sweep-{i:02d}", global_interior=(8, 8, 8), members=4,
+            n_steps=20, make_states=make_states(i, 4),
+            step_fn=member_step, watch_every=5, checkpoint_every=5,
+            chaos=chaos))
+    return jobs
+
+
+def _final_interiors(ring_dir, members):
+    """Each member's interior from a ring's newest generation, restored
+    onto a canonical (2,2,2) grid (decomposition-independent compare)."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    out = igg.load_checkpoint(igg.latest_checkpoint(ring_dir, "ens"),
+                              redistribute=True)
+    T = out["T"]                                    # (X, Y, Z, M)
+    got = np.stack([np.asarray(igg.gather_interior(T[..., m]))
+                    for m in range(members)])
+    igg.finalize_global_grid()
+    return got
+
+
+def main():
+    import jax
+
+    wd = os.path.join(tempfile.gettempdir(), "igg_fleet_run")
+    ref_wd = os.path.join(tempfile.gettempdir(), "igg_fleet_run_ref")
+    for d in (wd, ref_wd):
+        shutil.rmtree(d, ignore_errors=True)
+
+    log = lambda ev: print(f"  [{ev.kind:>17}] step {ev.step} "
+                           f"job={ev.detail.get('job', '?')}")
+
+    # ---- uninterrupted reference fleet: the bit-exactness oracle ----
+    print("reference fleet (no faults, 8 devices)")
+    ref = igg.run_fleet(_jobs(nan_member=False), ref_wd)
+    assert all(o.status == "done" for o in ref.jobs.values())
+
+    # ---- faulted fleet: member NaN in sweep-01, preempt sweep-02 ----
+    print("fleet with member NaN @ (step 7, member 2) in sweep-01 and a "
+          "preemption of sweep-02 @ step 10")
+    with igg.chaos.job_preempt_at("sweep-02", 10):
+        res = igg.run_fleet(_jobs(), wd, on_event=log)
+    assert res.preempted
+    a = res.jobs["sweep-01"]
+    assert a.status == "done" and a.result.quarantined == []
+    rb = [e for e in a.events if e.kind == "member_rollback"]
+    assert rb and rb[0].detail["members"] == [2], rb
+    assert res.jobs["sweep-02"].status == "preempted"
+    print("  sweep-01: member 2 isolated and recovered; batch completed")
+    print("  sweep-02: preempted, journal persisted")
+
+    # ---- relaunch on HALF the devices: elastic resume ----
+    print("relaunch with resume=True on 4 devices (half the capacity)")
+    res2 = igg.run_fleet(_jobs(), wd, resume=True,
+                         devices=jax.devices()[:4], on_event=log)
+    assert all(o.status == "done" for o in res2.jobs.values())
+    assert res2.jobs["sweep-00"].result is None        # skipped: was done
+    assert any(e.kind == "job_resumed"
+               for e in res2.jobs["sweep-02"].events)
+    assert res2.jobs["sweep-02"].dims != (2, 2, 2)     # re-planned
+
+    # ---- bit-exactness: every job, every member, vs the clean fleet ----
+    ok = True
+    for name in ("sweep-00", "sweep-01", "sweep-02"):
+        got = _final_interiors(os.path.join(wd, "jobs", name), 4)
+        want = _final_interiors(os.path.join(ref_wd, "jobs", name), 4)
+        same = np.array_equal(got, want)
+        ok = ok and same
+        print(f"  {name}: {'bit-identical' if same else 'MISMATCH'} vs "
+              f"uninterrupted run")
+    assert ok
+    for d in (wd, ref_wd):
+        shutil.rmtree(d, ignore_errors=True)
+    print("fleet_run: OK")
+
+
+if __name__ == "__main__":
+    main()

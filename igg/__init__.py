@@ -25,9 +25,13 @@ compilation (`sharded`, `update_halo_local`, `local_coords`),
 `latest_checkpoint`, `verify_checkpoint`), the resilient run loop
 (`run_resilient` — device-side NaN watchdog, checkpoint ring with
 rollback-and-retry, preemption handling; fault injectors in `igg.chaos`),
-and the verified tier-degradation ladder (`igg.degrade` — kernel
+the verified tier-degradation ladder (`igg.degrade` — kernel
 quarantine with compile-failure capture, numeric verify-on-first-use
-against the pure-XLA composition truth, observable/resettable status).
+against the pure-XLA composition truth, observable/resettable status),
+and the ensemble/fleet tier (`igg.run_ensemble` — M independent members
+in one compiled program with per-member fault isolation and quarantine;
+`igg.run_fleet` — a job queue drained onto whatever devices exist, with
+retry/backoff, a persistent journal, and elastic resume).
 """
 
 from ._compat import install as _compat_install
@@ -86,10 +90,14 @@ from .checkpoint import (
     verify_checkpoint_distributed,
 )
 from .resilience import ResilienceError, RunResult, run_resilient
+from .ensemble import EnsembleResult, run_ensemble
+from .fleet import FleetResult, Job, JobOutcome, run_fleet
 from .timing import time_steps
 from . import chaos
 from . import degrade
 from . import device
+from . import ensemble
+from . import fleet
 from . import profiling
 from . import resilience
 from . import tools
@@ -114,5 +122,7 @@ __all__ = [
     "latest_checkpoint", "verify_checkpoint", "verify_checkpoint_distributed",
     "run_resilient", "RunResult", "ResilienceError", "resilience", "chaos",
     "degrade", "vis",
+    "run_ensemble", "EnsembleResult", "ensemble",
+    "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
     "time_steps", "__version__",
 ]

@@ -35,6 +35,14 @@ _KNOWN: Dict[str, str] = {
         "initial sleep between jax.distributed.initialize retries (s)",
     "IGG_DIST_INIT_TIMEOUT":
         "total seconds to keep retrying jax.distributed.initialize",
+    "IGG_ENSEMBLE_MAX_PENDING_PROBES":
+        "outstanding per-member watchdog probes before a forced fetch",
+    "IGG_ENSEMBLE_RETRIES":
+        "per-member rollback budget before a member is quarantined",
+    "IGG_FLEET_BACKOFF":
+        "initial sleep between fleet job-launch retries (s, doubling)",
+    "IGG_FLEET_RETRIES":
+        "launcher-fault retries per fleet job before it is marked failed",
     "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
     "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
     "IGG_TPU_TESTS": "1 runs the TPU-only test files on the real backend",

@@ -37,6 +37,13 @@ one-shot by default so a rolled-back replay does not re-fail:
   tier perturbs one interior output element by `magnitude` (a
   deterministic miscompile).  Host-level taps — never traced into
   compiled programs — so arming needs no cache clearing.
+- :func:`scheduler_fault` / :func:`job_preempt_at` — the fleet queue's
+  two failure shapes (round 11), through the `igg.fleet._CHAOS_JOB_TAP`
+  seam: a job launch raises a stand-in launcher fault (the
+  retry/backoff path), or a running job is preempted at a step (the
+  journal-persist + elastic-resume path).  :class:`ChaosPlan` itself
+  grew member-targeted `nan_at` entries `(step, member, field)` for the
+  per-member isolation paths of :mod:`igg.ensemble`.
 
 Prefer the exception-safe context managers — every injector supports
 ``with`` directly, and :func:`armed` composes several — so a test failure
@@ -62,18 +69,24 @@ from .shared import GridError
 
 __all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
            "HaloCorruption", "kernel_compile_fail", "kernel_corrupt",
-           "KernelChaos", "armed"]
+           "KernelChaos", "scheduler_fault", "job_preempt_at", "JobChaos",
+           "InjectedSchedulerFault", "armed"]
 
 
 class ChaosPlan:
-    """Deterministic in-loop fault plan for :func:`igg.run_resilient`.
+    """Deterministic in-loop fault plan for :func:`igg.run_resilient` and
+    :func:`igg.run_ensemble`.
 
     `nan_at`: iterable of `(step, field)` or `(step, field, index)` — before
     the dispatch that advances past `step`, write NaN into `state[field]` at
     `index` (default: element `(1, 1, ...)`, an INTERIOR cell of the block
     on device (0,0,0) — a halo cell would be healed by the next exchange
     before any stencil reads it, which is exactly the fault that needs no
-    recovery).
+    recovery).  MEMBER-TARGETED entries `(step, member, field)` or
+    `(step, member, field, index)` — the second element an int — poison
+    only that member's lane of an ensemble-stacked state (`index` is then
+    within the member's stacked field), which is what proves the per-member
+    isolation paths of :mod:`igg.ensemble`.
     `preempt_at`: simulate a preemption signal when the loop reaches that
     step.  Each injection fires ONCE (a transient fault): after rollback the
     replay passes the same step clean, which is exactly what makes
@@ -82,10 +95,23 @@ class ChaosPlan:
 
     def __init__(self, nan_at: Sequence = (),
                  preempt_at: Optional[int] = None):
-        self.nan_at: Tuple = tuple(
-            (e[0], e[1],
-             tuple(e[2]) if len(e) > 2 and e[2] is not None else None)
-            for e in nan_at)
+        entries = []
+        for e in nan_at:
+            if len(e) >= 2 and isinstance(e[1], (int, np.integer)):
+                # (step, member, field[, index])
+                if len(e) < 3 or not isinstance(e[2], str):
+                    raise GridError(
+                        f"ChaosPlan: member-targeted nan_at entry {e!r} "
+                        f"must be (step, member, field) or "
+                        f"(step, member, field, index).")
+                entries.append((int(e[0]), int(e[1]), e[2],
+                                tuple(e[3]) if len(e) > 3 and e[3] is not None
+                                else None))
+            else:
+                entries.append((int(e[0]), None, e[1],
+                                tuple(e[2]) if len(e) > 2 and e[2] is not None
+                                else None))
+        self.nan_at: Tuple = tuple(entries)
         self.preempt_at = preempt_at
         self._fired = set()
 
@@ -101,16 +127,19 @@ class ChaosPlan:
         to "at step k" when k is inside a compiled multi-step dispatch).
         `emit(kind, step, **detail)` logs the injection into the run's
         event stream so tests can anchor assertions to it."""
-        for k, field, index in self.nan_at:
-            key = ("nan", k, field, index)
+        for k, member, field, index in self.nan_at:
+            key = ("nan", k, member, field, index)
             if step <= k < step + span and key not in self._fired:
                 self._fired.add(key)
                 if field not in state:
                     raise GridError(f"ChaosPlan: field {field!r} not in "
                                     f"state {sorted(state)}.")
                 state = dict(state)
-                state[field] = _poison(state[field], index)
-                emit("chaos_nan", step, field=field)
+                state[field] = _poison(state[field], index, member=member)
+                detail = {"field": field}
+                if member is not None:
+                    detail["member"] = member
+                emit("chaos_nan", step, **detail)
         if (self.preempt_at is not None
                 and step <= self.preempt_at < step + span
                 and ("preempt", self.preempt_at) not in self._fired):
@@ -122,16 +151,27 @@ class ChaosPlan:
         return state
 
 
-def _poison(A, index=None):
+def _poison(A, index=None, member=None):
     """NaN written into one element of a (sharded) grid array, sharding
-    preserved."""
+    preserved.  With `member`, `A` is an ensemble-stacked array (leading
+    member axis) and only that member's lane is poisoned (`index` within
+    the lane; default: an interior cell of the lane's first block)."""
     import jax
     import jax.numpy as jnp
 
     if not jnp.issubdtype(A.dtype, jnp.inexact):
         raise GridError(f"ChaosPlan: cannot seed NaN into dtype {A.dtype}.")
-    idx = (tuple(index) if index is not None
-           else tuple(min(1, s - 1) for s in A.shape))
+    if member is not None:
+        if not 0 <= member < A.shape[0]:
+            raise GridError(
+                f"ChaosPlan: member {member} out of range for a stacked "
+                f"array of {A.shape[0]} member(s).")
+        lane = A.shape[1:]
+        idx = (member,) + (tuple(index) if index is not None
+                           else tuple(min(1, s - 1) for s in lane))
+    else:
+        idx = (tuple(index) if index is not None
+               else tuple(min(1, s - 1) for s in A.shape))
     out = A.at[idx].set(jnp.asarray(float("nan"), A.dtype))
     sharding = getattr(A, "sharding", None)
     return jax.device_put(out, sharding) if sharding is not None else out
@@ -350,6 +390,75 @@ def kernel_corrupt(tier: str, magnitude: float = float("nan")) \
     heal on rollback — recovery requires demoting the tier
     (`igg.degrade.demote_active`, the `run_resilient` recovery rung)."""
     return KernelChaos("corrupt", tier, magnitude)
+
+
+class JobChaos:
+    """Armed fleet-queue fault (see :func:`scheduler_fault` /
+    :func:`job_preempt_at`): merges its entry into the
+    `igg.fleet._CHAOS_JOB_TAP` seam on `arm()` and removes exactly it on
+    `disarm()` — the `KernelChaos` pattern applied to the job scheduler.
+    Host-level (consulted at job launch), so no cache clearing.  Entries
+    are one-shot: the scheduler consumes them as they fire, so a retried
+    or resumed job launches clean — which is what makes
+    retry-with-backoff and elastic resume provable."""
+
+    def __init__(self, kind: str, job: str, payload):
+        self._kind = kind          # "fault" | "preempt"
+        self._job = job
+        self._payload = payload
+
+    def arm(self) -> "JobChaos":
+        from . import fleet
+
+        tap = fleet._CHAOS_JOB_TAP or {}
+        tap.setdefault(self._kind, {})[self._job] = self._payload
+        fleet._CHAOS_JOB_TAP = tap
+        return self
+
+    def disarm(self) -> None:
+        from . import fleet
+
+        tap = fleet._CHAOS_JOB_TAP
+        if not tap:
+            return
+        tap.get(self._kind, {}).pop(self._job, None)
+        if not any(tap.get(k) for k in tap):
+            fleet._CHAOS_JOB_TAP = None
+
+    def __enter__(self) -> "JobChaos":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+class InjectedSchedulerFault(RuntimeError):
+    """Stand-in launcher fault raised by :func:`scheduler_fault` — the
+    job-setup failure shape (driver OOM, device grab race, transient
+    filesystem error at state build)."""
+
+
+def scheduler_fault(job: str, times: int = 1,
+                    message: Optional[str] = None) -> JobChaos:
+    """Context manager making the next `times` LAUNCHES of fleet job `job`
+    raise an :class:`InjectedSchedulerFault` before any step runs — the
+    transient launcher-fault shape the scheduler's retry/exponential-
+    backoff path must absorb::
+
+        with igg.chaos.scheduler_fault("sweep-03", times=2):
+            res = igg.run_fleet(jobs, workdir)   # job retries, then runs
+    """
+    return JobChaos("fault", job, {"times": int(times),
+                                   "message": message})
+
+
+def job_preempt_at(job: str, step: int) -> JobChaos:
+    """Context manager preempting fleet job `job` when it reaches `step`
+    (a `ChaosPlan(preempt_at=step)` merged into the job's run by the
+    scheduler): the job writes its final generation, the queue journal
+    persists, and a later `run_fleet(..., resume=True)` must resume it
+    elastically — one-shot, so the resumed run completes."""
+    return JobChaos("preempt", job, {"step": int(step)})
 
 
 @contextlib.contextmanager

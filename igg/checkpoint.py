@@ -589,6 +589,25 @@ def remove_generation(path) -> None:
         pass
 
 
+def prune_generations(directory, prefix: str, ring: int,
+                      good_until: int) -> None:
+    """The checkpoint-ring prune rule shared by :func:`igg.run_resilient`
+    and :func:`igg.run_ensemble`: keep the newest `ring` generations PLUS
+    the newest one at or below `good_until` — the health-established
+    rollback target.  With a checkpoint cadence much shorter than the
+    watch cadence, several unconfirmed (possibly poisoned) generations
+    can land before the first probe is fetched, and a plain newest-R
+    prune would rotate the only healthy target out of the ring."""
+    gens = list_generations(directory, prefix)
+    keep = {s for s, _ in gens[-ring:]}
+    good = [s for s, _ in gens if s <= good_until]
+    if good:
+        keep.add(max(good))
+    for s, p in gens:
+        if s not in keep:
+            remove_generation(p)
+
+
 def latest_checkpoint(directory, prefix: str = "ckpt", *,
                       check_finite: bool = False,
                       distributed: bool = False,

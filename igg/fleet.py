@@ -1,0 +1,506 @@
+"""Fleet scheduler — a queue of simulation jobs drained onto whatever
+devices exist, with per-job retry, preemption persistence, and elastic
+resume.
+
+The "millions of users" tier of ROADMAP item 1: a *job* is a config — a
+global domain, a member count, a step function — and the scheduler owns
+everything a parameter-sweep driver would otherwise reinvent per launch:
+
+- **Packing.**  Each job's Cartesian decomposition is planned against the
+  devices that are actually present (`_plan_dims`: balanced factor
+  triples of the device count, first one whose dims divide the job's
+  global interior), so the same queue runs on a laptop CPU mesh, half a
+  slice, or a full pod — and a RESUMED queue re-plans against the new
+  capacity: the job's checkpoint ring re-tiles elastically through
+  `igg.load_checkpoint(redistribute=True)` (the PR-4 path,
+  `run_ensemble(resume=True)` rides it).
+- **Per-job fault domain.**  Inside a job, member blowups are isolated by
+  :func:`igg.run_ensemble` (per-member rollback/quarantine — a diverging
+  member never kills the job, let alone the queue).  Around a job, a
+  LAUNCHER fault (driver OOM, device grab race, transient filesystem
+  error while building states) is retried with exponential backoff
+  (`IGG_FLEET_RETRIES`/`IGG_FLEET_BACKOFF`); exhaustion marks the job
+  `failed` and the queue drains on — one bad config cannot starve the
+  fleet.
+- **Preemption.**  SIGTERM (or `igg.resilience.request_preemption`)
+  reaches the in-flight job's run loop, which writes its final generation
+  on the way out; the scheduler records `preempted` in the queue journal
+  and stops draining.  `run_fleet(..., resume=True)` re-admits every
+  unfinished job: `done` jobs are skipped, `preempted`/`running` jobs
+  resume from their rings (a `job_resumed` event), `queued` jobs start
+  fresh — on whatever devices now exist.
+- **The queue journal** (`{workdir}/journal.json`, format
+  igg-fleet-journal-v1) is the scheduler's commit record: one atomic
+  rewrite per state transition (`queued` → `running` → `done` | `failed`
+  | `preempted`), carrying per-job attempts, steps done, member
+  quarantines, and the dims the job last ran under.  A crash between
+  transitions reads as `running`, which resume treats like `preempted`
+  (resume from the ring — the ring's own commit protocol guarantees a
+  loadable generation or none).
+
+Chaos: :func:`igg.chaos.scheduler_fault` and
+:func:`igg.chaos.job_preempt_at` inject both failure shapes
+deterministically through the `_CHAOS_JOB_TAP` seam (consumed one-shot at
+launch), so the retry/backoff and preempt/resume paths are proven on the
+8-device CPU mesh (`tests/test_fleet.py`, `examples/fleet_run.py`).
+Throughput headline: `benchmarks/fleet_throughput.py` (jobs/hour).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import pathlib
+import signal
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import shared
+from .shared import GridError, NDIMS
+from .resilience import Event, ResilienceError, clear_preemption, \
+    preemption_requested, request_preemption
+
+__all__ = ["Job", "JobOutcome", "FleetResult", "run_fleet", "plan_dims"]
+
+_JOURNAL = "journal.json"
+_JOURNAL_FORMAT = "igg-fleet-journal-v1"
+
+# Chaos seam (igg.chaos.scheduler_fault / job_preempt_at): a dict
+# {"fault": {job: {"times": n, "message": ...}},
+#  "preempt": {job: {"step": k}}} consulted at job launch, entries
+# consumed one-shot as they fire.
+_CHAOS_JOB_TAP: Optional[dict] = None
+
+
+def _fleet_retries_default() -> int:
+    from . import _env
+
+    return int(_env.integer("IGG_FLEET_RETRIES", 2))
+
+
+def _fleet_backoff_default() -> float:
+    from . import _env
+
+    return float(_env.number("IGG_FLEET_BACKOFF", 0.5))
+
+
+@dataclasses.dataclass
+class Job:
+    """One fleet job: a config plus a member count.
+
+    - `name`: unique queue key (journal identity across resumes).
+    - `step_fn`: the LOCAL member step (the :func:`igg.run_ensemble`
+      contract) — rebuilt by the caller on every launch, so it can close
+      over the freshly initialized grid.  When `make_step` is given it is
+      called as `make_step(grid)` after grid init and its result serves
+      instead (for steps that need grid-dependent constants).
+    - `make_states(grid) -> [state dicts]`: builds the M member states on
+      the live grid.  Must be decomposition-independent (global-coordinate
+      initialization, the `igg.from_local_blocks` idiom) for elastic
+      resume to be bit-exact.
+    - `global_interior`: the de-duplicated global interior size per dim —
+      the decomposition-invariant domain (`periodic: dims*(n-ol)`;
+      `open: dims*(n-ol)+ol`).  The scheduler plans `dims` against the
+      live devices and derives each local size from it.
+    - `members`, `n_steps`, and the :func:`igg.run_ensemble` cadence knobs.
+    """
+    name: str
+    global_interior: Tuple[int, int, int]
+    members: int
+    n_steps: int
+    make_states: Callable = None
+    step_fn: Callable = None
+    make_step: Callable = None
+    periods: Tuple[int, int, int] = (1, 1, 1)
+    overlaps: Tuple[int, int, int] = (2, 2, 2)
+    watch_every: int = 10
+    checkpoint_every: int = 10
+    ring: int = 3
+    member_retries: Optional[int] = None
+    steps_per_call: int = 1
+    packing: str = "auto"
+    chaos: object = None
+
+
+@dataclasses.dataclass
+class JobOutcome:
+    """Per-job record in a :class:`FleetResult`: terminal `status`
+    ('done', 'failed', 'preempted', or 'queued' when the fleet stopped
+    before reaching it), launcher `attempts` consumed, the job's
+    :class:`igg.EnsembleResult` (None unless it ran to a result this
+    drain), its event list, and the `dims` it ran under."""
+    status: str
+    attempts: int
+    result: object = None
+    events: List[Event] = dataclasses.field(default_factory=list)
+    dims: Optional[Tuple[int, int, int]] = None
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FleetResult:
+    jobs: Dict[str, JobOutcome]
+    preempted: bool
+    journal: pathlib.Path
+
+
+# ---------------------------------------------------------------------------
+# Decomposition planning
+# ---------------------------------------------------------------------------
+
+def _divisors(n: int) -> List[int]:
+    out = set()
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            out.add(d)
+            out.add(n // d)
+        d += 1
+    return sorted(out)
+
+
+@functools.lru_cache(maxsize=4096)
+def _factor_triples(n: int) -> Tuple[Tuple[int, int, int], ...]:
+    """All (dx, dy, dz) with dx*dy*dz == n, most balanced first (the
+    `MPI_Dims_create` preference), deterministic order.  Divisor-based
+    and memoized: the planner scans device counts N..1 per job launch,
+    and an O(N) enumeration per count would make that scan quadratic at
+    pod scale."""
+    triples = []
+    for dx in _divisors(n):
+        for dy in _divisors(n // dx):
+            triples.append((dx, dy, n // (dx * dy)))
+    return tuple(sorted(triples,
+                        key=lambda t: (max(t) - min(t), -t[0], -t[1])))
+
+
+def plan_dims(global_interior, n_devices: int, *, periods=(1, 1, 1),
+              overlaps=(2, 2, 2)) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Plan a Cartesian decomposition of `global_interior` onto AT MOST
+    `n_devices` devices: the largest device count with a balanced factor
+    triple whose dims divide the interior per dim and keep every local
+    size a legal grid (`nx >= 2`, periodic dims >= `2*ol - 1`).  Returns
+    `(dims, local)` — the `init_global_grid` arguments; raises `GridError`
+    when not even one device fits."""
+    g = [int(v) for v in global_interior]
+    per = [int(v) for v in periods]
+    ol = [int(v) for v in overlaps]
+    for nd in range(int(n_devices), 0, -1):
+        for dims in _factor_triples(nd):
+            local = []
+            for d in range(NDIMS):
+                span = g[d] if per[d] else g[d] - ol[d]
+                if span % dims[d]:
+                    local = None
+                    break
+                n = span // dims[d] + ol[d]
+                if n < 2 or (per[d] and n < 2 * ol[d] - 1):
+                    local = None
+                    break
+                local.append(n)
+            if local is None:
+                continue
+            if local[1] == 1 and local[2] > 1:
+                continue          # init_global_grid's ny/nz rule
+            return tuple(dims), tuple(local)
+    raise GridError(
+        f"plan_dims: no decomposition of global interior {g} "
+        f"(periods {per}, overlaps {ol}) fits onto <= {n_devices} "
+        f"device(s).")
+
+
+# ---------------------------------------------------------------------------
+# The queue journal
+# ---------------------------------------------------------------------------
+
+def _read_journal(path: pathlib.Path) -> dict:
+    try:
+        j = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return {"format": _JOURNAL_FORMAT, "jobs": {}}
+    if j.get("format") != _JOURNAL_FORMAT or not isinstance(
+            j.get("jobs"), dict):
+        return {"format": _JOURNAL_FORMAT, "jobs": {}}
+    return j
+
+
+def _write_journal(path: pathlib.Path, journal: dict) -> None:
+    from .checkpoint import _write_atomic_text
+
+    _write_atomic_text(path, json.dumps(journal, indent=1, sort_keys=True))
+
+
+# ---------------------------------------------------------------------------
+# The scheduler
+# ---------------------------------------------------------------------------
+
+def _consume_tap(kind: str, job: str):
+    """Pop/consume one chaos entry for `job` (one-shot semantics)."""
+    global _CHAOS_JOB_TAP
+    tap = _CHAOS_JOB_TAP
+    if not tap or job not in tap.get(kind, {}):
+        return None
+    entry = tap[kind][job]
+    if kind == "fault":
+        entry["times"] -= 1
+        if entry["times"] <= 0:
+            tap[kind].pop(job)
+    else:
+        tap[kind].pop(job)
+    if not any(tap.get(k) for k in tap):
+        _CHAOS_JOB_TAP = None
+    return entry
+
+
+def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
+              resume: bool = False, max_job_retries: Optional[int] = None,
+              backoff: Optional[float] = None,
+              install_sigterm: bool = True,
+              on_event: Optional[Callable[[Event], None]] = None
+              ) -> FleetResult:
+    """Drain `jobs` in order onto the live devices (module docstring for
+    the full contract).  The caller must NOT hold an initialized grid —
+    the scheduler owns grid lifecycle per job.  `resume=True` reconciles
+    against the journal under `workdir`: finished jobs are skipped,
+    interrupted ones resume from their checkpoint rings (elastically, on
+    whatever `devices` now exist).  Returns a :class:`FleetResult`;
+    `on_event` receives every job-scoped event (detail carries `job`)."""
+    import jax
+
+    if shared.grid_is_initialized():
+        raise GridError(
+            "run_fleet: finalize the global grid first — the scheduler "
+            "initializes one grid per job.")
+    names = [j.name for j in jobs]
+    if len(set(names)) != len(names):
+        raise GridError(f"run_fleet: duplicate job names in {names}.")
+    for j in jobs:
+        if j.make_states is None or (j.step_fn is None
+                                     and j.make_step is None):
+            raise GridError(f"run_fleet: job {j.name!r} needs make_states "
+                            f"and step_fn (or make_step).")
+    if max_job_retries is None:
+        max_job_retries = _fleet_retries_default()
+    if backoff is None:
+        backoff = _fleet_backoff_default()
+    devs = list(devices) if devices is not None else list(jax.devices())
+
+    workdir = pathlib.Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    jpath = workdir / _JOURNAL
+    journal = _read_journal(jpath) if resume else {
+        "format": _JOURNAL_FORMAT, "jobs": {}}
+    outcomes: Dict[str, JobOutcome] = {}
+
+    def _emit(kind, step, **detail) -> Event:
+        ev = Event(kind, step, detail)
+        if on_event is not None:
+            on_event(ev)
+        return ev
+
+    def _jrec(job: Job) -> dict:
+        rec = journal["jobs"].setdefault(job.name, {
+            "status": "queued", "attempts": 0, "steps_done": 0,
+            "members": job.members, "quarantined": [], "dims": None})
+        return rec
+
+    def _transition(job: Job, **updates) -> None:
+        _jrec(job).update(updates)
+        journal["jobs"][job.name]["updated_at"] = time.time()
+        _write_journal(jpath, journal)
+
+    installed = False
+    old_handler = None
+    if install_sigterm:
+        try:
+            old_handler = signal.signal(signal.SIGTERM, request_preemption)
+            installed = True
+        except ValueError:
+            pass
+
+    fleet_preempted = False
+    try:
+        for job in jobs:
+            rec = _jrec(job)
+            if resume and rec["status"] == "done":
+                outcomes[job.name] = JobOutcome(
+                    status="done", attempts=rec["attempts"],
+                    dims=tuple(rec["dims"]) if rec["dims"] else None)
+                continue
+            if fleet_preempted or preemption_requested():
+                fleet_preempted = True
+                outcomes[job.name] = JobOutcome(status="queued",
+                                                attempts=rec["attempts"])
+                break
+            resume_job = resume and rec["status"] in ("preempted",
+                                                      "running")
+            outcome = _run_job(job, workdir / "jobs" / job.name, devs,
+                               resume_job, max_job_retries, backoff,
+                               _emit, _transition, rec)
+            outcomes[job.name] = outcome
+            # Stop draining on an in-run preemption, a preemption that
+            # interrupted a launcher-fault backoff (the job went back to
+            # 'queued'), or a SIGTERM that landed after the job's run
+            # loop last checked (run_ensemble leaves the flag to its
+            # owner — this scheduler — when install_sigterm=False).
+            if outcome.status == "preempted" or preemption_requested():
+                fleet_preempted = True
+                break
+        for job in jobs:
+            if job.name not in outcomes:
+                outcomes[job.name] = JobOutcome(
+                    status="queued",
+                    attempts=journal["jobs"].get(job.name,
+                                                 {}).get("attempts", 0))
+        _write_journal(jpath, journal)
+    finally:
+        if installed:
+            signal.signal(signal.SIGTERM, old_handler)
+            # Owner-only clear (the igg.ensemble rule): with
+            # install_sigterm=False a supervisor owns the wiring, and
+            # clearing here would swallow a SIGTERM that landed after
+            # this drain's last check.
+            clear_preemption()
+
+    return FleetResult(jobs=outcomes, preempted=fleet_preempted,
+                       journal=jpath)
+
+
+def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
+             max_job_retries: int, backoff: float, _emit, _transition,
+             rec) -> JobOutcome:
+    """Launch one job with retry/exponential-backoff around LAUNCHER
+    faults (grid init, decomposition planning, state build, compile) —
+    a fault inside the run itself is the ensemble tier's problem."""
+    import igg
+
+    from .chaos import InjectedSchedulerFault
+    from .ensemble import run_ensemble
+
+    events: List[Event] = []
+
+    def job_event(ev: Event) -> None:
+        ev2 = Event(ev.kind, ev.step, {**ev.detail, "job": job.name})
+        events.append(ev2)
+        _emit(ev.kind, ev.step, **ev2.detail)
+
+    attempt = rec["attempts"]   # journal-cumulative (all launches, ever)
+    faults = 0                  # THIS drain's launcher faults: the budget
+    #                             is per drain, so a job that was
+    #                             preempted/resumed several times keeps
+    #                             its full fault tolerance each time
+    delay = backoff
+    while True:
+        attempt += 1
+        _transition(job, status="running", attempts=attempt)
+        try:
+            fault = _consume_tap("fault", job.name)
+            if fault is not None:
+                raise InjectedSchedulerFault(
+                    fault.get("message")
+                    or f"injected launcher fault for job {job.name!r}")
+            # Batch packing needs the degenerate single-device grid (the
+            # member axis, not the domain, spans the devices); otherwise
+            # pack the domain onto as many devices as divide it.
+            cap = 1 if job.packing == "batch" else len(devs)
+            dims, local = plan_dims(job.global_interior, cap,
+                                    periods=job.periods,
+                                    overlaps=job.overlaps)
+            ndev = int(np.prod(dims))
+            igg.init_global_grid(
+                *local, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                periodx=job.periods[0], periody=job.periods[1],
+                periodz=job.periods[2], overlapx=job.overlaps[0],
+                overlapy=job.overlaps[1], overlapz=job.overlaps[2],
+                devices=devs[:ndev], quiet=True)
+            try:
+                grid = igg.get_global_grid()
+                step_fn = (job.make_step(grid) if job.make_step is not None
+                           else job.step_fn)
+                states = job.make_states(grid)
+                chaos = job.chaos
+                pre = _consume_tap("preempt", job.name)
+                if pre is not None:
+                    from .chaos import ChaosPlan
+
+                    if chaos is None:
+                        chaos = ChaosPlan(preempt_at=pre["step"])
+                    else:
+                        chaos.preempt_at = pre["step"]
+                job_event(Event("job_started", 0,
+                                {"attempt": attempt, "dims": list(dims),
+                                 "devices": ndev, "resume": resume_job}))
+                res = run_ensemble(
+                    step_fn, states, job.n_steps, members=job.members,
+                    watch_every=job.watch_every,
+                    checkpoint_dir=jobdir,
+                    checkpoint_every=job.checkpoint_every, ring=job.ring,
+                    member_retries=job.member_retries,
+                    resume=resume_job, steps_per_call=job.steps_per_call,
+                    packing=job.packing, devices=devs,
+                    install_sigterm=False, on_event=job_event,
+                    chaos=chaos)
+                if resume_job and any(e.kind == "resume"
+                                      for e in res.events):
+                    job_event(Event("job_resumed",
+                                    next(e.step for e in res.events
+                                         if e.kind == "resume"),
+                                    {"devices": ndev, "dims": list(dims)}))
+            finally:
+                igg.finalize_global_grid()
+        except Exception as e:          # launcher fault: retry with backoff
+            if igg.grid_is_initialized():
+                igg.finalize_global_grid()
+            job_event(Event("job_failed", 0,
+                            {"attempt": attempt,
+                             "error": f"{type(e).__name__}: {e}"}))
+            # The documented fault split: only LAUNCHER faults are
+            # transient and worth a backoff retry.  The run's own terminal
+            # verdicts are deterministic — an all-quarantined ensemble
+            # (ResilienceError) or an invalid config (GridError) fails the
+            # same way on every replay, and retrying would re-run the
+            # whole job from scratch for nothing.
+            faults += 1
+            terminal = isinstance(e, (ResilienceError, GridError))
+            if terminal or faults > max_job_retries:
+                _transition(job, status="failed", attempts=attempt)
+                job_event(Event("job_gave_up", 0, {"attempts": attempt,
+                                                   "terminal": terminal}))
+                return JobOutcome(status="failed", attempts=attempt,
+                                  events=events,
+                                  error=f"{type(e).__name__}: {e}")
+
+            def _requeued():
+                # A preemption landing around the backoff must not sleep
+                # it out and relaunch (grid init + compile) just to stop:
+                # hand the job back to the queue and let the drain stop.
+                _transition(job, status="queued", attempts=attempt)
+                job_event(Event("job_requeued", 0,
+                                {"reason": "preempted during "
+                                           "launcher-fault backoff"}))
+                return JobOutcome(status="queued", attempts=attempt,
+                                  events=events,
+                                  error=f"{type(e).__name__}: {e}")
+
+            if preemption_requested():
+                return _requeued()
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+            if preemption_requested():   # SIGTERM during the sleep
+                return _requeued()
+            continue
+
+        status = "preempted" if res.preempted else "done"
+        _transition(job, status=status, attempts=attempt,
+                    steps_done=res.steps_done,
+                    quarantined=res.quarantined, dims=list(dims))
+        job_event(Event("job_preempted" if res.preempted else "job_done",
+                        res.steps_done,
+                        {"quarantined": res.quarantined,
+                         "retries": {str(m): r
+                                     for m, r in res.retries.items()}}))
+        return JobOutcome(status=status, attempts=attempt, result=res,
+                          events=events, dims=tuple(dims))

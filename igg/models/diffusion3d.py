@@ -114,6 +114,39 @@ def local_step(T, Cp, *, dx, dy, dz, dt, lam, overlap: bool = False,
                                  assembly=assembly)
 
 
+def make_member_step(params: Params = Params()):
+    """Per-member LOCAL step over the `{"T", "Cp"}` state dict — the
+    :func:`igg.run_ensemble` contract (the step is vmapped over the member
+    axis inside one `shard_map` program, so it must be the local-arrays
+    form, not an `igg.sharded`-wrapped whole-mesh program).  A member
+    state may also carry a per-member scalar `"dt_scale"` field (a swept
+    parameter): the timestep becomes `dt * dt_scale` for that member.
+
+    The XLA assembly path is pinned: inside the vmapped ensemble program
+    the halo select chain fuses into the stencil output pass exactly like
+    the composed single-member step (the measured round-6 choice)."""
+    dx, dy, dz = params.spacing()
+    dt, lam = params.timestep(), params.lam
+    rdx2, rdy2, rdz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
+
+    def member_step(st):
+        from igg.ops import diffusion_compute
+
+        # The coefficient is formed per member (dt_scale may be a traced
+        # per-member scalar, so the composed-step float() shortcut of
+        # `compute_step` does not apply here).
+        coeff = dt * lam
+        if "dt_scale" in st:
+            coeff = coeff * st["dt_scale"]
+        T = diffusion_compute(st["T"], coeff / st["Cp"], rdx2=rdx2,
+                              rdy2=rdy2, rdz2=rdz2)
+        out = dict(st)
+        out["T"] = igg.update_halo_local(T, assembly="xla")
+        return out
+
+    return member_step
+
+
 _PALLAS_REQ = (
     "the fused Pallas step requires TPU devices (or interpret=True), "
     "an overlap-2 grid, and an f32 unstaggered field with local "

@@ -37,10 +37,17 @@ examples don't reinvent it:
   transfer or a filesystem write; the writer is DRAINED before any
   rollback scan, before the final preemption generation, and at end of
   run.  A failed background write degrades the ring depth by one and emits
-  a 'checkpoint_failed' event instead of killing the run.  (Async holds
+  a 'checkpoint_failed' event instead of killing the run.  Async holds
   references to the snapshotted buffers until written — a `step_fn` that
-  DONATES its input buffers would invalidate them; use sync writes or
-  donate=False steps.)  :func:`igg.latest_checkpoint` scans newest-first
+  DONATES its input buffers would invalidate them, so donation is
+  DETECTED: each dispatch is probed (pre-step buffers deleted afterwards
+  ⇒ donating; donation is runtime-dependent, so probing continues until
+  first observed) and the writer's worker/submit check snapshot buffers
+  for deletion; either way cadence generations degrade to synchronous
+  writes with a one-time structured warning instead of crashing or
+  silently losing generations (at most the one generation already in
+  flight when donation first strikes is lost, with a diagnosis).
+  :func:`igg.latest_checkpoint` scans newest-first
   and skips corrupt/truncated/uncommitted generations, so one damaged by a
   crash or preemption mid-write degrades the rollback depth by one instead
   of killing the run.
@@ -190,6 +197,15 @@ def _is_ready(x) -> bool:
         return True
 
 
+def _is_deleted(x) -> bool:
+    """Whether a snapshot buffer has been invalidated (donated to a later
+    dispatch) — the async-checkpoint hazard the writer detects."""
+    try:
+        return bool(x.is_deleted())
+    except AttributeError:   # non-jax value: cannot be donated
+        return False
+
+
 class _AsyncCheckpointWriter:
     """Background checkpoint writer — the :class:`igg.vis.BackgroundRenderer`
     shape applied to the resilience ring's cadence generations.
@@ -207,14 +223,26 @@ class _AsyncCheckpointWriter:
     :meth:`poll` (non-blocking, per loop iteration) and :meth:`drain`
     (blocking — the synchronization point before any rollback scan, the
     final preemption generation, and end of run) both return
-    `([(step, path)], [(step, error)])` — failures carry the step of the
-    generation that failed to write (not whatever step the caller happens
-    to be at when it polls), so the 'checkpoint_failed' event names the
-    actual lost ring slot.  A failed write surfaces as an error — one
-    generation of ring depth lost — never as an exception on the hot
-    loop.  The save function must not involve device collectives
+    `([(step, path, background)], [(step, error)])` — failures carry the
+    step of the generation that failed to write (not whatever step the
+    caller happens to be at when it polls), so the 'checkpoint_failed'
+    event names the actual lost ring slot.  A failed write surfaces as an
+    error — one generation of ring depth lost — never as an exception on
+    the hot loop.  The save function must not involve device collectives
     (:func:`igg.save_checkpoint_sharded` is filesystem-coordinated, so it
-    qualifies)."""
+    qualifies).
+
+    DONATION GUARD (the documented async hazard, closed in round 11):
+    snapshots are held by reference, so a step that donates its input
+    buffers invalidates them before the worker can fetch.  The worker
+    detects a deleted snapshot buffer (`is_deleted`) and fails that
+    generation with a donation diagnosis; from then on — or immediately,
+    when the caller pre-announces via :meth:`note_donation`, or when a
+    submit arrives with already-deleted buffers — `submit` degrades to a
+    SYNCHRONOUS write on the caller's thread (where the buffers are
+    alive), with a one-time structured warning: generations stop being
+    lost instead of failing one by one.  Completions carry
+    `background=False` for these sync-degraded writes."""
 
     def __init__(self, save_fn, *, maxsize: int = 2):
         from .vis import BackgroundRenderer
@@ -222,6 +250,8 @@ class _AsyncCheckpointWriter:
         self._save_fn = save_fn
         self._done: deque = deque()    # (step, path), appended by the worker
         self._failed: deque = deque()  # (step, exception), ditto
+        self._donation_seen = False    # a snapshot buffer was invalidated
+        self._warned_donation = False
         self._r = BackgroundRenderer(self._consume, maxsize=maxsize,
                                      name="igg-ckpt-writer")
 
@@ -230,16 +260,74 @@ class _AsyncCheckpointWriter:
 
         step, fields, last_good = batch
         try:
-            while not all(_is_ready(a) for a in fields.values()):
+            while True:
+                if any(_is_deleted(a) for a in fields.values()):
+                    # The documented donation hazard struck: a later
+                    # dispatch donated (invalidated) the snapshot's
+                    # buffers before this write could fetch them.  Flag it
+                    # so `submit` degrades every subsequent generation to
+                    # a synchronous write instead of losing them one by
+                    # one — and fail THIS generation with a diagnosis
+                    # instead of a raw runtime error (or silent garbage).
+                    self._donation_seen = True
+                    raise RuntimeError(
+                        "snapshot buffers were deleted (donated to a "
+                        "later dispatch) before the background write "
+                        "fetched them — step_fn donates its inputs; "
+                        "subsequent generations degrade to synchronous "
+                        "writes")
+                if all(_is_ready(a) for a in fields.values()):
+                    break
                 time.sleep(0.002)
             path = self._save_fn(step, fields, last_good)
         except BaseException as e:
             self._failed.append((step, e))
             return
-        self._done.append((step, path))
+        self._done.append((step, path, True))
+
+    def _warn_donation(self) -> None:
+        if self._warned_donation:
+            return
+        self._warned_donation = True
+        import warnings
+
+        warnings.warn(
+            "igg.run_resilient: step_fn DONATES its input buffers, so "
+            "asynchronous checkpoint snapshots (held by reference) are "
+            "invalidated before the background writer can fetch them; "
+            "cadence generations now degrade to synchronous writes for "
+            "the rest of the run (use donate=False steps to keep async "
+            "writes).  (Warned once per run.)", stacklevel=3)
+
+    def note_donation(self) -> None:
+        """Tell the writer the caller's step donates its buffers (detected
+        before any generation was submitted): every submit degrades to a
+        synchronous write, zero generations lost."""
+        self._donation_seen = True
 
     def submit(self, step: int, fields: Dict, last_good: int) -> None:
-        self._r.submit((step, dict(fields), last_good))
+        snap = dict(fields)
+        deleted_now = any(_is_deleted(a) for a in snap.values())
+        if self._donation_seen or deleted_now:
+            # Donation detected — at submit time (the buffers handed in
+            # are already invalid: nothing can be written) or by the
+            # worker on an earlier generation.  Degrade to a synchronous
+            # write on the caller's thread, where the buffers are alive.
+            self._donation_seen = True
+            self._warn_donation()
+            if deleted_now:
+                self._failed.append((step, RuntimeError(
+                    "state buffers were already deleted (donated) at "
+                    "submit time — nothing valid to checkpoint")))
+                return
+            try:
+                path = self._save_fn(step, snap, last_good)
+            except BaseException as e:
+                self._failed.append((step, e))
+                return
+            self._done.append((step, path, False))   # sync-degraded write
+            return
+        self._r.submit((step, snap, last_good))
 
     def _results(self):
         done, errs = [], []
@@ -459,14 +547,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         health-established one (`good_until` — see `last_good`)."""
         if jax.process_index() != 0:
             return
-        gens = _generations()
-        keep = {s for s, _ in gens[-ring:]}
-        good = [s for s, _ in gens if s <= good_until]
-        if good:
-            keep.add(max(good))   # the healthy rollback target survives
-        for s, old in gens:
-            if s not in keep:
-                ckpt.remove_generation(old)
+        ckpt.prune_generations(cdir, prefix, ring, good_until)
 
     def _write_gen(step, fields, good_until) -> pathlib.Path:
         """Write one generation and prune the ring — runs on the caller's
@@ -499,16 +580,24 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         if writer is None:
             return
         done, errs = writer.drain() if drain else writer.poll()
-        for step_w, p in done:
-            _record_gen(step_w, p, background=True)
+        for step_w, p, background in done:
+            _record_gen(step_w, p, background=background)
         for step_w, e in errs:
             # One ring generation lost; the run continues.
             _emit("checkpoint_failed", step_w,
                   error=f"{type(e).__name__}: {e}")
 
+    # Set when the first dispatch proves step_fn donates its input buffers
+    # (the pre-step state is deleted afterwards): async snapshots would be
+    # invalidated before the writer fetches them, so cadence generations
+    # degrade to synchronous writes — detected BEFORE the first async
+    # submit, zero generations lost (the writer's own submit-time guard
+    # covers direct users of _AsyncCheckpointWriter).
+    donating = False
+
     def _save_gen(step, sync: bool = True) -> None:
         nonlocal writer
-        if not sync and use_async:
+        if not sync and use_async and not donating:
             if writer is None:
                 writer = _AsyncCheckpointWriter(_write_gen)
             writer.submit(step, state, last_good)
@@ -663,6 +752,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             _save_gen(steps_done)
 
         final_probe_done = False
+        donation_probe = bool(use_async)   # probe until donation observed
         while True:
             while steps_done < n_steps:
                 if _preempt.is_set():
@@ -674,7 +764,35 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     if _preempt.is_set():
                         preempted = True
                         break
+                # EVERY field is probed: a step may donate some fields but
+                # not the dict's first one (e.g. a pass-through
+                # coefficient), and missing the donation would cost a ring
+                # generation before the writer's own guard catches up.
+                prev = tuple(state.values()) if donation_probe else ()
                 state = step_fn(state)
+                if donation_probe and any(_is_deleted(x) for x in prev):
+                    # Donation is runtime-dependent (a dispatch whose
+                    # input buffer is externally referenced — e.g. by a
+                    # checkpoint fetch — may copy instead of alias), so
+                    # every dispatch is probed until deletion is first
+                    # OBSERVED; from then on cadence generations degrade
+                    # to synchronous writes.
+                    donation_probe = False
+                    donating = True
+                    if writer is not None:
+                        writer.note_donation()
+                    import warnings
+
+                    warnings.warn(
+                        "igg.run_resilient: step_fn DONATES its input "
+                        "buffers (the pre-step state was invalidated "
+                        "by the dispatch); asynchronous checkpoint "
+                        "snapshots would be deleted before the "
+                        "background writer fetches them — cadence "
+                        "generations degrade to synchronous writes "
+                        "for this run (use donate=False steps to keep "
+                        "async writes).  (Warned once per run.)",
+                        stacklevel=2)
                 steps_done += steps_per_call
                 fail = None
                 if probe is not None and steps_done % watch_every == 0:

@@ -145,6 +145,46 @@ def assert_halo_agreement(stacked, lshape):
                              f"blocks {tuple(left)} and {tuple(right)}"))
 
 
+def ensemble_member_step(rate=0.1):
+    """The standard ensemble test harness: a radius-1 Laplacian relaxation
+    as a LOCAL member step over the `{"T": ...}` state dict — the
+    :func:`igg.run_ensemble` contract (vmapped over the member axis inside
+    one shard_map program; an extra per-member scalar `"rate_scale"`
+    field, when present, scales the relaxation rate — the parameter-sweep
+    shape)."""
+    from igg.ops import interior_add
+
+    def member_step(st):
+        T = st["T"]
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        r = rate * st["rate_scale"] if "rate_scale" in st else rate
+        out = dict(st)
+        out["T"] = igg.update_halo_local(interior_add(T, r * lap))
+        return out
+
+    return member_step
+
+
+def ensemble_states(members, lshape=(6, 6, 6), seed=3, rate_scales=None):
+    """M member state dicts with deterministic random interiors (halos
+    exchanged so every member starts globally consistent); with
+    `rate_scales` each member also carries a per-member scalar
+    `"rate_scale"` parameter field."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for m in range(members):
+        T = igg.from_local_blocks(
+            lambda c, ls: rng.standard_normal(ls), lshape)
+        st = {"T": igg.update_halo(T)}
+        if rate_scales is not None:
+            st["rate_scale"] = np.float64(rate_scales[m])
+        out.append(st)
+    return out
+
+
 def roundtrip(lshape, dtype=np.float64):
     """Run the full oracle: encode → zero halos → update_halo → (result,
     expected)."""

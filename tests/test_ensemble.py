@@ -1,0 +1,467 @@
+"""The ensemble tier (igg/ensemble.py) on the 8-device CPU mesh: M
+independent members in ONE compiled program, with every per-member
+isolation path PROVEN through the member-targeted chaos injectors —
+per-member attribution of the fused probe (single, multiple-simultaneous,
+and member-0 edge), isolated rollback (healthy members bit-identical to an
+uninterrupted run), retry-budget quarantine (the batch completes),
+preemption + elastic resume onto a different decomposition, and both
+packings (grid-sharded members and the batch-axis NamedSharding)."""
+
+import numpy as np
+import pytest
+
+import igg
+from helpers import ensemble_member_step, ensemble_states
+
+
+def _grid(**kw):
+    args = dict(periodx=1, periody=1, periodz=1, quiet=True)
+    args.update(kw)
+    igg.init_global_grid(6, 6, 6, **args)          # (2,2,2) mesh
+
+
+def _clean(step_fn, states, n, **kw):
+    """Uninterrupted ensemble run — the bit-exactness oracle."""
+    return igg.run_ensemble(step_fn, states, n, watch_every=0,
+                            install_sigterm=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Per-member attribution: the M-vector probe fingers exactly the injected
+# member(s)
+# ---------------------------------------------------------------------------
+
+def test_probe_attributes_single_member(tmp_path):
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 2, "T")])
+    res = igg.run_ensemble(step, ensemble_states(4), 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    div = [e for e in res.events if e.kind == "member_diverged"]
+    assert len(div) == 1
+    assert div[0].detail["members"] == [2]          # exactly the injected one
+    assert 7 < div[0].step <= 12                    # within one watch window
+    assert div[0].detail["counts"]["T"].keys() == {2}
+    assert res.quarantined == []
+
+
+def test_probe_attributes_multiple_simultaneous_members(tmp_path):
+    """Two members blowing up inside the SAME watch window are both
+    fingered by one probe — and only them."""
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(nan_at=[(6, 1, "T"), (7, 3, "T")])
+    res = igg.run_ensemble(step, ensemble_states(5), 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    div = [e for e in res.events if e.kind == "member_diverged"]
+    assert div and div[0].detail["members"] == [1, 3]
+    rb = next(e for e in res.events if e.kind == "member_rollback")
+    assert rb.detail["members"] == [1, 3]
+    assert res.quarantined == []
+
+
+def test_probe_attributes_member_zero_edge(tmp_path):
+    """Member 0 — the edge lane of the stacked axis — is attributed like
+    any other (an off-by-one in the lane indexing would misattribute or
+    miss it)."""
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 0, "T")])
+    res = igg.run_ensemble(step, ensemble_states(3), 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    div = [e for e in res.events if e.kind == "member_diverged"]
+    assert div and div[0].detail["members"] == [0]
+    assert res.quarantined == []
+
+
+# ---------------------------------------------------------------------------
+# Isolation: rollback restores ONLY the diverged member
+# ---------------------------------------------------------------------------
+
+def test_isolated_recovery_bit_exact(tmp_path):
+    """One member NaNs; the run recovers with only that member rolled
+    back, and EVERY member — the recovered one included — finishes
+    bit-identical to an uninterrupted run."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(4)
+    ref = np.asarray(_clean(step, states, 20).state["T"])
+
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 2, "T")])
+    res = igg.run_ensemble(step, states, 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    rb = next(e for e in res.events if e.kind == "member_rollback")
+    assert rb.detail["members"] == [2]
+    assert res.steps_done == 20 and res.retries == {2: 1}
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_rollback_skips_lane_poisoned_generation(tmp_path):
+    """A generation written between the blowup and its detection holds the
+    poisoned LANE; the per-lane finite gate must skip it for that member
+    and land on the older healthy one — while the same generation would
+    still serve a different member."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    ref = np.asarray(_clean(step, states, 20).state["T"])
+    # checkpoint_every=2 < watch_every=10: gens 8/10 are written after the
+    # step-7 injection but before the step-10 probe is fetched.
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 1, "T")])
+    res = igg.run_ensemble(step, states, 20, watch_every=10,
+                           checkpoint_dir=tmp_path, checkpoint_every=2,
+                           ring=10, chaos=plan)
+    rb = next(e for e in res.events if e.kind == "member_rollback")
+    assert rb.step <= 6                 # not the lane-poisoned 8/10 gens
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_member_scalar_parameters_sweep(tmp_path):
+    """Per-member scalar fields (the parameter-sweep shape) flow through
+    the vmapped step — members genuinely differ — and survive checkpoint
+    round-trips bit-exactly via the sidecar."""
+    _grid()
+    step = ensemble_member_step()
+    scales = [1.0, 0.5, 2.0, 1.25]
+    states = ensemble_states(4, rate_scales=scales)
+    res = igg.run_ensemble(step, states, 10, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5)
+    got = np.asarray(res.state["T"])
+    assert not np.array_equal(got[0], got[1])      # the sweep is real
+    np.testing.assert_array_equal(np.asarray(res.state["rate_scale"]),
+                                  np.asarray(scales))
+    # The sidecar carries the parameter lanes bit-exactly.
+    out = igg.run_ensemble(step, [{k: np.zeros_like(np.asarray(v))
+                                   for k, v in st.items()}
+                                  for st in states], 10,
+                           watch_every=5, checkpoint_dir=tmp_path,
+                           checkpoint_every=5, resume=True)
+    np.testing.assert_array_equal(np.asarray(out.state["rate_scale"]),
+                                  np.asarray(scales))
+    np.testing.assert_array_equal(np.asarray(out.state["T"]), got)
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: retry-budget exhaustion isolates, the batch completes
+# ---------------------------------------------------------------------------
+
+def test_retry_exhaustion_quarantines_member_batch_completes(tmp_path):
+    """A persistently-faulting member exhausts its per-member budget and
+    is QUARANTINED (masked out of step and verdict) instead of raising
+    ResilienceError for the batch; healthy members finish bit-identical
+    to an uninterrupted run."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(4)
+    ref = np.asarray(_clean(step, states, 20).state["T"])
+
+    plan = igg.chaos.ChaosPlan(
+        nan_at=[(s, 1, "T") for s in (6, 7, 8, 9, 11, 12, 13, 14, 16, 17)])
+    res = igg.run_ensemble(step, states, 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           member_retries=2, chaos=plan)
+    assert res.quarantined == [1]
+    q = next(e for e in res.events if e.kind == "member_quarantined")
+    assert q.detail["member"] == 1 and q.detail["reason"] == "retry_budget"
+    assert res.steps_done == 20
+    for m in (0, 2, 3):
+        np.testing.assert_array_equal(np.asarray(res.state["T"])[m],
+                                      ref[m])
+
+
+def test_no_rollback_target_quarantines_not_raises():
+    """Detection with no ring configured quarantines the member (reason
+    no_rollback_target) — the batch completes; only an ALL-quarantined
+    ensemble raises."""
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(nan_at=[(3, 1, "T")])
+    res = igg.run_ensemble(step, ensemble_states(3), 10, watch_every=5,
+                           chaos=plan)
+    assert res.quarantined == [1]
+    q = next(e for e in res.events if e.kind == "member_quarantined")
+    assert q.detail["reason"] == "no_rollback_target"
+    assert res.steps_done == 10
+
+
+def test_all_members_quarantined_raises():
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(nan_at=[(3, 0, "T"), (3, 1, "T")])
+    with pytest.raises(igg.ResilienceError, match="every member"):
+        igg.run_ensemble(step, ensemble_states(2), 10, watch_every=5,
+                         chaos=plan)
+
+
+def test_quarantine_persists_through_resume(tmp_path):
+    """The sidecar carries quarantine state: a resumed ensemble masks the
+    NaN lane instead of re-detecting (and re-paying retries for) it."""
+    _grid()
+    step = ensemble_member_step()
+    plan = igg.chaos.ChaosPlan(
+        nan_at=[(s, 0, "T") for s in (2, 3, 6, 7, 8, 9, 11, 12)],
+        preempt_at=15)
+    res = igg.run_ensemble(step, ensemble_states(3), 25, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           member_retries=1, chaos=plan)
+    assert res.preempted and res.quarantined == [0]
+
+    res2 = igg.run_ensemble(step, ensemble_states(3), 25, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            member_retries=1, resume=True)
+    assert res2.events[0].kind == "resume"
+    assert res2.events[0].detail["quarantined"] == [0]
+    assert res2.quarantined == [0] and res2.steps_done == 25
+    assert not any(e.kind == "member_diverged" for e in res2.events)
+
+
+# ---------------------------------------------------------------------------
+# Preemption + elastic resume
+# ---------------------------------------------------------------------------
+
+def test_preempt_and_elastic_resume_different_topology(tmp_path):
+    """A preempted ensemble on the (2,2,2) mesh resumes on a (1,2,4)
+    decomposition — every member's interior finishes bit-identical to an
+    uninterrupted (2,2,2) run (the acceptance criterion)."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    clean = _clean(step, states, 20)
+    ref = np.stack([np.asarray(igg.gather_interior(clean.state["T"][m]))
+                    for m in range(3)])
+
+    plan = igg.chaos.ChaosPlan(preempt_at=10)
+    res = igg.run_ensemble(step, states, 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    assert res.preempted and res.steps_done == 10
+    igg.finalize_global_grid()
+
+    # Same periodic global domain (2*(6-2) = 8 per dim) on (1,2,4).
+    igg.init_global_grid(10, 6, 4, dimx=1, dimy=2, dimz=4,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    dummy = ensemble_states(3, lshape=(10, 6, 4), seed=99)
+    res2 = igg.run_ensemble(step, dummy, 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            resume=True)
+    assert res2.events[0].kind == "resume" and res2.events[0].step == 10
+    assert res2.steps_done == 20
+    got = np.stack([np.asarray(igg.gather_interior(res2.state["T"][m]))
+                    for m in range(3)])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_rollback_after_elastic_resume_uses_old_geometry_gens(tmp_path):
+    """A divergence right after an elastic resume — before any
+    post-resume cadence write — must roll back into the OLD
+    decomposition's generations (elastic lane restore), not quarantine
+    the member because those generations 'mismatch' the live grid."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    clean = _clean(step, states, 20)
+    ref = np.stack([np.asarray(igg.gather_interior(clean.state["T"][m]))
+                    for m in range(3)])
+    plan = igg.chaos.ChaosPlan(preempt_at=10)
+    res = igg.run_ensemble(step, states, 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=20,
+                           chaos=plan)
+    assert res.preempted and res.steps_done == 10
+    igg.finalize_global_grid()
+
+    # Resume on (1,2,4); checkpoint_every=20 means NO new generation
+    # exists when member 1 NaNs at step 12 — the only rollback targets
+    # are the (2,2,2)-geometry generations.
+    igg.init_global_grid(10, 6, 4, dimx=1, dimy=2, dimz=4,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    dummy = ensemble_states(3, lshape=(10, 6, 4), seed=99)
+    plan2 = igg.chaos.ChaosPlan(nan_at=[(12, 1, "T")])
+    res2 = igg.run_ensemble(step, dummy, 20, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=20,
+                            resume=True, chaos=plan2)
+    assert res2.quarantined == []                  # rolled back, not lost
+    rb = next(e for e in res2.events if e.kind == "member_rollback")
+    assert rb.detail["members"] == [1]
+    got = np.stack([np.asarray(igg.gather_interior(res2.state["T"][m]))
+                    for m in range(3)])
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# Batch packing (the batch-axis NamedSharding)
+# ---------------------------------------------------------------------------
+
+def test_batch_packing_auto_and_isolation(tmp_path):
+    """On a dims=(1,1,1) grid with 8 devices available, auto packing
+    shards the MEMBER axis (one compiled program, M/8 members per
+    device); attribution and isolated recovery hold there too."""
+    import jax
+
+    igg.init_global_grid(8, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True,
+                         devices=jax.devices()[:1])
+    step = ensemble_member_step()
+    states = ensemble_states(16, lshape=(8, 8, 8))
+    clean = _clean(step, states, 10)
+    assert clean.packing == "batch"
+
+    plan = igg.chaos.ChaosPlan(nan_at=[(3, 9, "T")])
+    res = igg.run_ensemble(step, states, 10, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    assert res.packing == "batch"
+    div = [e for e in res.events if e.kind == "member_diverged"]
+    assert div and div[0].detail["members"] == [9]
+    np.testing.assert_array_equal(np.asarray(res.state["T"]),
+                                  np.asarray(clean.state["T"]))
+
+
+def test_batch_to_grid_elastic_resume(tmp_path):
+    """A batch-packed ensemble's generation resumes GRID-packed on the
+    (2,2,2) mesh — the lane layout is packing-agnostic."""
+    import jax
+
+    igg.init_global_grid(8, 8, 8, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True,
+                         devices=jax.devices()[:1])
+    step = ensemble_member_step()
+    states = ensemble_states(8, lshape=(8, 8, 8))
+    clean = _clean(step, states, 10)
+    ref = np.stack([np.asarray(igg.gather_interior(clean.state["T"][m]))
+                    for m in range(8)])
+    plan = igg.chaos.ChaosPlan(preempt_at=5)
+    res = igg.run_ensemble(step, states, 10, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    assert res.preempted and res.packing == "batch"
+    igg.finalize_global_grid()
+
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    dummy = ensemble_states(8, lshape=(5, 5, 5), seed=42)
+    res2 = igg.run_ensemble(step, dummy, 10, watch_every=5,
+                            checkpoint_dir=tmp_path, checkpoint_every=5,
+                            resume=True)
+    assert res2.packing == "grid" and res2.steps_done == 10
+    got = np.stack([np.asarray(igg.gather_interior(res2.state["T"][m]))
+                    for m in range(8)])
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# Contract validation
+# ---------------------------------------------------------------------------
+
+def test_resume_matching_nothing_owns_a_fresh_ring(tmp_path):
+    """resume=True over generations no candidate can serve (wrong member
+    count) starts fresh AND clears them: left in place, the stale
+    high-step generations would win every newest-`ring` prune and the
+    fresh run would have no rollback target."""
+    _grid()
+    step = ensemble_member_step()
+    # A previous 2-member run leaves gens at high steps.
+    igg.run_ensemble(step, ensemble_states(2), 200, watch_every=100,
+                     checkpoint_dir=tmp_path, checkpoint_every=100)
+    # A 3-member resume can use none of them.
+    states = ensemble_states(3)
+    ref = np.asarray(_clean(step, states, 20).state["T"])
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 1, "T")])
+    res = igg.run_ensemble(step, states, 20, watch_every=5,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           ring=3, resume=True, chaos=plan)
+    assert not any(e.kind == "resume" for e in res.events)
+    # The divergence still had a rollback target (the fresh ring
+    # survived pruning) — no quarantine, bit-exact recovery.
+    assert res.quarantined == [] and res.steps_done == 20
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
+    from igg.checkpoint import list_generations
+    steps = [s for s, _ in list_generations(tmp_path, "ens")]
+    assert max(steps) == 20 and 100 not in steps and 200 not in steps
+
+
+def test_argument_validation(tmp_path):
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(2)
+    with pytest.raises(igg.GridError, match="members"):
+        igg.run_ensemble(step, {"T": np.zeros((2, 12, 12, 12))}, 10)
+    with pytest.raises(igg.GridError, match="checkpoint_dir"):
+        igg.run_ensemble(step, states, 10, checkpoint_every=5)
+    with pytest.raises(igg.GridError, match="steps_per_call"):
+        igg.run_ensemble(step, states, 10, steps_per_call=3)
+    with pytest.raises(igg.GridError, match="packing"):
+        igg.run_ensemble(step, states, 10, packing="bogus")
+    with pytest.raises(igg.GridError, match="batch"):
+        igg.run_ensemble(step, states, 10, packing="batch")   # (2,2,2) grid
+    # member-targeted chaos entries validate eagerly
+    with pytest.raises(igg.GridError, match="member-targeted"):
+        igg.chaos.ChaosPlan(nan_at=[(3, 1)])
+
+
+def test_preempt_during_catchup_completes_replay_first(tmp_path):
+    """A preemption that lands while a rollback cohort is mid-replay (and
+    a chaos plan is still armed) must let the cohort reach the front and
+    then preempt — the round-11 review hang: the chaos block's preempt
+    skip starving the replay forever."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    # NaN at 2 detected by the step-4 probe; preempt fires at 3 — i.e.
+    # BEFORE the rollback, so the whole catch-up replay runs with the
+    # preemption flag already set.
+    plan = igg.chaos.ChaosPlan(nan_at=[(2, 0, "T")], preempt_at=3)
+    res = igg.run_ensemble(step, states, 8, watch_every=4,
+                           checkpoint_dir=tmp_path, checkpoint_every=4,
+                           chaos=plan)
+    assert res.preempted and res.quarantined == []
+    assert any(e.kind == "member_rollback" for e in res.events)
+    # The recovered lane is healthy in the final (preemption) generation.
+    res2 = igg.run_ensemble(step, states, 8, watch_every=4,
+                            checkpoint_dir=tmp_path, checkpoint_every=4,
+                            resume=True)
+    assert res2.steps_done == 8 and res2.quarantined == []
+    ref = np.asarray(_clean(step, states, 8).state["T"])
+    np.testing.assert_array_equal(np.asarray(res2.state["T"]), ref)
+
+
+def test_tail_rollback_rewrites_stale_final_generation(tmp_path):
+    """A divergence caught at the front AFTER the cadence generation at
+    that step was written: the tail rollback replays the lane, and the
+    final generation must be REWRITTEN (not just re-sealed) so
+    `result.checkpoint` holds the returned, healthy state."""
+    import jax.numpy as jnp
+
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    ref = np.asarray(_clean(step, states, 10).state["T"])
+    # watch_every == n_steps: the only probe fires at the front, after
+    # the poisoned cadence generation at step 10 is already on disk.
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, 1, "T")])
+    res = igg.run_ensemble(step, states, 10, watch_every=10,
+                           checkpoint_dir=tmp_path, checkpoint_every=5,
+                           chaos=plan)
+    assert res.steps_done == 10 and res.quarantined == []
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)
+    out = igg.load_checkpoint(res.checkpoint)
+    got = np.asarray(jnp.moveaxis(out["T"], -1, 0))
+    np.testing.assert_array_equal(got, ref)      # lane 1 healthy on disk
+
+
+def test_steps_per_call_folds_dispatches(tmp_path):
+    """steps_per_call folds k steps into one compiled dispatch (an
+    in-program fori_loop); cadences count steps and results match the
+    one-step-per-dispatch run bit-exactly."""
+    _grid()
+    step = ensemble_member_step()
+    states = ensemble_states(3)
+    ref = np.asarray(_clean(step, states, 20).state["T"])
+    res = igg.run_ensemble(step, states, 20, watch_every=10,
+                           checkpoint_dir=tmp_path, checkpoint_every=10,
+                           steps_per_call=5)
+    assert res.steps_done == 20
+    np.testing.assert_array_equal(np.asarray(res.state["T"]), ref)

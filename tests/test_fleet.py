@@ -1,0 +1,224 @@
+"""The fleet scheduler (igg/fleet.py) on the 8-device CPU mesh: queue
+draining with per-job grid lifecycle, decomposition planning against the
+live devices, launcher-fault retry with exponential backoff, SIGTERM/
+preemption persistence through the queue journal, and elastic re-admission
+onto different capacity — every path driven by the deterministic fleet
+chaos injectors (`scheduler_fault`, `job_preempt_at`)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import igg
+from igg.fleet import plan_dims
+from helpers import ensemble_member_step, ensemble_states
+
+
+def _make_states(seed, members):
+    """Member states built from a decomposition-INVARIANT global random
+    field (wrap-indexed per block), so elastic resume comparisons are
+    meaningful across dims."""
+    def build(grid):
+        rng = np.random.default_rng(seed)
+        g = [grid.dims[d] * (grid.nxyz[d] - grid.overlaps[d])
+             for d in range(3)]
+        out = []
+        for _ in range(members):
+            glob = rng.standard_normal(g)
+
+            def block(coords, ls, glob=glob):
+                idx = [(coords[d] * (ls[d] - grid.overlaps[d])
+                        + np.arange(ls[d])) % g[d] for d in range(3)]
+                return glob[np.ix_(*idx)]
+
+            T = igg.from_local_blocks(block, tuple(grid.nxyz))
+            out.append({"T": igg.update_halo(T)})
+        return out
+    return build
+
+
+def _job(name, seed=1, members=2, n_steps=10, **kw):
+    args = dict(name=name, global_interior=(8, 8, 8), members=members,
+                n_steps=n_steps, make_states=_make_states(seed, members),
+                step_fn=ensemble_member_step(), watch_every=5,
+                checkpoint_every=5)
+    args.update(kw)
+    return igg.Job(**args)
+
+
+# ---------------------------------------------------------------------------
+# Decomposition planning
+# ---------------------------------------------------------------------------
+
+def test_plan_dims_balanced_and_divisible():
+    dims, local = plan_dims((8, 8, 8), 8)
+    assert dims == (2, 2, 2) and local == (6, 6, 6)
+    dims, local = plan_dims((8, 8, 8), 4)
+    assert np.prod(dims) == 4 and all(
+        d * (n - 2) == 8 for d, n in zip(dims, local))
+    dims, local = plan_dims((8, 8, 8), 1)
+    assert dims == (1, 1, 1) and local == (10, 10, 10)
+    # Open boundaries: global = dims*(n-ol) + ol.
+    dims, local = plan_dims((10, 10, 10), 8, periods=(0, 0, 0))
+    assert all(d * (n - 2) + 2 == 10 for d, n in zip(dims, local))
+    # A prime interior that no 8-way split divides falls back to fewer
+    # devices rather than failing.
+    dims, _ = plan_dims((7, 7, 7), 8)
+    assert np.prod(dims) == 7
+    with pytest.raises(igg.GridError, match="no decomposition"):
+        plan_dims((1, 8, 8), 8, periods=(0, 0, 0))   # nx would be 1
+
+
+# ---------------------------------------------------------------------------
+# Queue draining + journal
+# ---------------------------------------------------------------------------
+
+def test_queue_drains_and_journal_records(tmp_path):
+    jobs = [_job("a", seed=1), _job("b", seed=2, members=4)]
+    res = igg.run_fleet(jobs, tmp_path)
+    assert not res.preempted
+    assert all(o.status == "done" for o in res.jobs.values())
+    assert res.jobs["a"].dims == (2, 2, 2)
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["format"] == "igg-fleet-journal-v1"
+    assert {n: r["status"] for n, r in j["jobs"].items()} == {
+        "a": "done", "b": "done"}
+    assert j["jobs"]["b"]["steps_done"] == 10
+    # Per-job event streams carry the job name.
+    assert all(e.detail["job"] == "a" for e in res.jobs["a"].events)
+    assert not igg.grid_is_initialized()     # scheduler owns grid lifecycle
+
+
+def test_member_fault_isolated_inside_job(tmp_path):
+    """A member NaN inside a job is the ensemble tier's problem: the job
+    completes 'done' with zero quarantines and the queue never notices."""
+    jobs = [_job("a", chaos=igg.chaos.ChaosPlan(nan_at=[(3, 1, "T")])),
+            _job("b", seed=2)]
+    res = igg.run_fleet(jobs, tmp_path)
+    assert all(o.status == "done" for o in res.jobs.values())
+    assert res.jobs["a"].result.quarantined == []
+    assert any(e.kind == "member_rollback" for e in res.jobs["a"].events)
+
+
+def test_resume_skips_done_jobs(tmp_path):
+    jobs = [_job("a")]
+    res = igg.run_fleet(jobs, tmp_path)
+    assert res.jobs["a"].status == "done" and res.jobs["a"].attempts == 1
+    res2 = igg.run_fleet(jobs, tmp_path, resume=True)
+    assert res2.jobs["a"].status == "done"
+    assert res2.jobs["a"].result is None       # skipped, not re-run
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["jobs"]["a"]["attempts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Launcher faults: retry with exponential backoff
+# ---------------------------------------------------------------------------
+
+def test_scheduler_fault_retried_with_backoff(tmp_path):
+    jobs = [_job("a")]
+    with igg.chaos.scheduler_fault("a", times=2):
+        res = igg.run_fleet(jobs, tmp_path, backoff=0.01)
+    o = res.jobs["a"]
+    assert o.status == "done" and o.attempts == 3
+    fails = [e for e in o.events if e.kind == "job_failed"]
+    assert len(fails) == 2
+    assert "InjectedSchedulerFault" in fails[0].detail["error"]
+
+
+def test_fault_exhaustion_fails_job_queue_continues(tmp_path):
+    """A job that keeps faulting is marked failed after the budget; the
+    NEXT job still runs — one bad config cannot starve the fleet."""
+    jobs = [_job("a"), _job("b", seed=2)]
+    with igg.chaos.scheduler_fault("a", times=10):
+        res = igg.run_fleet(jobs, tmp_path, backoff=0.01,
+                            max_job_retries=2)
+    assert res.jobs["a"].status == "failed"
+    assert res.jobs["a"].attempts == 3
+    assert any(e.kind == "job_gave_up" for e in res.jobs["a"].events)
+    assert res.jobs["b"].status == "done"
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["jobs"]["a"]["status"] == "failed"
+
+
+# ---------------------------------------------------------------------------
+# Preemption + elastic re-admission
+# ---------------------------------------------------------------------------
+
+def test_preempt_persists_queue_and_elastic_resume(tmp_path):
+    """job_preempt_at preempts job 'a' mid-run: its final generation and
+    the journal persist, the rest of the queue stays queued; a resumed
+    fleet on HALF the devices re-admits both — the preempted job resumes
+    elastically (different dims) and finishes bit-identical to an
+    uninterrupted run of the same job."""
+    import jax
+
+    jobs = [_job("a"), _job("b", seed=2)]
+    with igg.chaos.job_preempt_at("a", 5):
+        res = igg.run_fleet(jobs, tmp_path)
+    assert res.preempted
+    assert res.jobs["a"].status == "preempted"
+    assert res.jobs["a"].result.steps_done == 5
+    assert res.jobs["b"].status == "queued"
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["jobs"]["a"]["status"] == "preempted"
+
+    res2 = igg.run_fleet(jobs, tmp_path, resume=True,
+                         devices=jax.devices()[:4])
+    assert all(o.status == "done" for o in res2.jobs.values())
+    assert any(e.kind == "job_resumed" for e in res2.jobs["a"].events)
+    assert res2.jobs["a"].dims != (2, 2, 2)        # genuinely re-planned
+    # Bit-exactness oracle: an uninterrupted run of the same job on the
+    # original capacity; interiors compared through a common restore.
+    res3 = igg.run_fleet([_job("a")], tmp_path / "clean")
+
+    def final_interior(ring_dir):
+        igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                             periodx=1, periody=1, periodz=1, quiet=True)
+        out = igg.load_checkpoint(igg.latest_checkpoint(ring_dir, "ens"),
+                                  redistribute=True)
+        got = np.asarray(igg.gather_interior(out["T"]))
+        igg.finalize_global_grid()
+        return got
+
+    np.testing.assert_array_equal(
+        final_interior(tmp_path / "jobs" / "a"),
+        final_interior(tmp_path / "clean" / "jobs" / "a"))
+
+
+def test_batch_packing_job_plans_single_device_grid(tmp_path):
+    """A Job with packing='batch' must be planned onto the degenerate
+    single-device grid (the member axis spans the devices), not failed
+    because the domain also decomposes."""
+    job = _job("a", members=8, packing="batch")
+    res = igg.run_fleet([job], tmp_path)
+    o = res.jobs["a"]
+    assert o.status == "done", o.error
+    assert o.dims == (1, 1, 1)
+    assert o.result.packing == "batch"
+
+
+def test_terminal_run_errors_fail_without_retry(tmp_path):
+    """Deterministic run-level failures (an all-quarantined ensemble's
+    ResilienceError, an invalid-config GridError) are NOT retried as
+    launcher faults: the job fails on attempt 1 and the queue drains on."""
+    doomed = _job("a", chaos=igg.chaos.ChaosPlan(
+        nan_at=[(3, 0, "T"), (3, 1, "T")]))   # both members: all-quarantine
+    doomed.ring = 0                            # invalid config -> GridError
+    jobs = [doomed, _job("b", seed=2)]
+    res = igg.run_fleet(jobs, tmp_path, backoff=0.01, max_job_retries=5)
+    assert res.jobs["a"].status == "failed"
+    assert res.jobs["a"].attempts == 1         # no backoff retries burned
+    gave = next(e for e in res.jobs["a"].events if e.kind == "job_gave_up")
+    assert gave.detail["terminal"] is True
+    assert res.jobs["b"].status == "done"
+
+
+def test_run_fleet_rejects_live_grid(tmp_path):
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    with pytest.raises(igg.GridError, match="finalize"):
+        igg.run_fleet([_job("a")], tmp_path)
+    igg.finalize_global_grid()
+    with pytest.raises(igg.GridError, match="duplicate"):
+        igg.run_fleet([_job("a"), _job("a")], tmp_path)

@@ -1,0 +1,254 @@
+"""Round-11 satellites: the async-checkpoint donation hazard closed
+(detection at the run loop AND at `_AsyncCheckpointWriter.submit`, sync
+degrade with a one-time structured warning), member-targeted ChaosPlan
+parsing, the fleet injectors composing under `igg.chaos.armed`, and the
+IGG_ENSEMBLE_* / IGG_FLEET_* knobs in the typed env registry."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import igg
+from igg.ops import interior_add
+
+
+def _grid():
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+
+
+def _donating_step():
+    @igg.sharded(donate_argnums=(0,))
+    def step(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(interior_add(T, 0.1 * lap))
+
+    return lambda st: {"T": step(st["T"])}
+
+
+def _state(seed=3):
+    rng = np.random.default_rng(seed)
+    T = igg.from_local_blocks(lambda c, ls: rng.standard_normal(ls),
+                              (6, 6, 6))
+    return {"T": igg.update_halo(T)}
+
+
+# ---------------------------------------------------------------------------
+# Donation hazard: async ring degrades to sync writes, warned once
+# ---------------------------------------------------------------------------
+
+def test_donating_step_degrades_async_ring_to_sync(tmp_path):
+    """The documented hazard: a donating step_fn invalidates async
+    snapshot buffers.  The loop detects the donation and degrades cadence
+    generations to synchronous writes — one structured warning, no
+    crashes, no silent garbage, and no ring generations lost once
+    detected."""
+    _grid()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = igg.run_resilient(_donating_step(), _state(), 20,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=5, ring=10)
+    don = [x for x in w if "DONATES" in str(x.message)]
+    assert len(don) == 1                       # one-time structured warning
+    assert res.steps_done == 20
+    cks = [e for e in res.events if e.kind == "checkpoint"]
+    # Every committed generation after detection is a sync write (no
+    # background label) and verifies.
+    assert cks and not any(e.detail.get("background") for e in cks)
+    from igg.checkpoint import list_generations
+    steps = [s for s, _ in list_generations(tmp_path)]
+    # Detection precedes the first async submit: zero generations lost.
+    assert set(steps) >= {10, 15, 20}
+    for _, p in list_generations(tmp_path):
+        assert igg.verify_checkpoint(p)
+
+
+def test_donation_probe_covers_every_field(tmp_path):
+    """A step that donates T but passes Cp through — with Cp FIRST in the
+    state dict — must still be detected (the probe checks every field,
+    not just the dict's first value)."""
+    from igg.ops import interior_add
+
+    _grid()
+
+    @igg.sharded(donate_argnums=(0,))
+    def dstep(T, Cp):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(
+            interior_add(T, 0.0 * Cp[1:-1, 1:-1, 1:-1] * lap))
+
+    rng = np.random.default_rng(5)
+    Cp = igg.update_halo(igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (6, 6, 6)))
+    T = igg.update_halo(igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (6, 6, 6)))
+
+    def step_fn(st):
+        return {"Cp": st["Cp"], "T": dstep(st["T"], st["Cp"])}
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = igg.run_resilient(step_fn, {"Cp": Cp, "T": T}, 20,
+                                watch_every=5, watch_fields=["T"],
+                                checkpoint_dir=tmp_path,
+                                checkpoint_every=5, ring=10)
+    assert len([x for x in w if "DONATES" in str(x.message)]) == 1
+    assert res.steps_done == 20
+    assert not any(e.kind == "checkpoint_failed" for e in res.events)
+    assert not any(e.detail.get("background") for e in res.events
+                   if e.kind == "checkpoint")
+
+
+def test_writer_submit_detects_deleted_snapshot(tmp_path):
+    """Direct users of _AsyncCheckpointWriter: a submit whose buffers were
+    already donated fails that generation with a diagnosis (nothing valid
+    to write), flips the writer to sync mode, and warns once; the next
+    submit with live buffers is written synchronously."""
+    import jax
+
+    from igg.resilience import _AsyncCheckpointWriter
+
+    _grid()
+    saved = []
+
+    def save_fn(step, fields, last_good):
+        jax.block_until_ready(list(fields.values()))
+        np.asarray(fields["T"])            # a deleted buffer would raise
+        saved.append(step)
+        return tmp_path / f"gen_{step}"
+
+    writer = _AsyncCheckpointWriter(save_fn)
+    step_fn = _donating_step()
+    st = _state()
+    dead = st["T"]
+    st = step_fn(st)                       # donates -> `dead` deleted
+    assert dead.is_deleted()
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        writer.submit(1, {"T": dead}, 0)       # already-invalid snapshot
+        writer.submit(2, dict(st), 0)          # live buffers: sync write
+        done, errs = writer.drain()
+    writer.close()
+    assert len([x for x in w if "DONATES" in str(x.message)]) == 1
+    assert [e[0] for e in errs] == [1]
+    assert "deleted" in str(errs[0][1])
+    assert [d[0] for d in done] == [2]
+    assert done[0][2] is False                 # sync-degraded, not background
+    assert saved == [2]
+
+
+def test_worker_detects_mid_flight_donation(tmp_path):
+    """The worker finds a snapshot buffer deleted while waiting to fetch
+    (the mid-flight donation shape): that generation fails with the
+    donation diagnosis and the writer flips to sync mode for subsequent
+    submits."""
+    from igg.resilience import _AsyncCheckpointWriter
+
+    _grid()
+
+    def save_fn(step, fields, last_good):
+        np.asarray(fields["T"])
+        return tmp_path / f"gen_{step}"
+
+    class _Gated:
+        """A snapshot stand-in that reports not-ready until 'donated',
+        then deleted — deterministic ordering for the worker's poll."""
+
+        def __init__(self):
+            self.deleted = False
+
+        def is_ready(self):
+            # The first poll observes in-flight work; the caller deletes
+            # before the next poll.
+            self.deleted = True
+            return False
+
+        def is_deleted(self):
+            return self.deleted
+
+    writer = _AsyncCheckpointWriter(save_fn)
+    writer.submit(1, {"T": _Gated()}, 0)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        done, errs = writer.drain()
+    writer.close()
+    assert [e[0] for e in errs] == [1]
+    assert "deleted" in str(errs[0][1]).lower()
+    assert writer._donation_seen
+
+
+# ---------------------------------------------------------------------------
+# Chaos: member-targeted entries + fleet injectors compose under armed()
+# ---------------------------------------------------------------------------
+
+def test_member_targeted_chaos_parsing():
+    plan = igg.chaos.ChaosPlan(nan_at=[(3, "T"), (4, "T", (1, 2, 3)),
+                                       (5, 2, "T"), (6, 0, "T", (2, 2, 2))])
+    assert plan.nan_at == ((3, None, "T", None), (4, None, "T", (1, 2, 3)),
+                           (5, 2, "T", None), (6, 0, "T", (2, 2, 2)))
+    with pytest.raises(igg.GridError, match="member-targeted"):
+        igg.chaos.ChaosPlan(nan_at=[(3, 1)])
+
+
+def test_member_poison_hits_only_that_lane():
+    _grid()
+    import jax
+
+    from igg.chaos import _poison
+
+    stacked = jax.device_put(np.zeros((4, 12, 12, 12)))
+    out = np.asarray(_poison(stacked, None, member=2))
+    assert np.isnan(out[2]).sum() == 1
+    assert np.isfinite(out[[0, 1, 3]]).all()
+    with pytest.raises(igg.GridError, match="out of range"):
+        _poison(stacked, None, member=7)
+
+
+def test_fleet_injectors_compose_under_armed():
+    from igg import fleet
+
+    assert fleet._CHAOS_JOB_TAP is None
+    with igg.chaos.armed(igg.chaos.scheduler_fault("a", times=2),
+                         igg.chaos.job_preempt_at("b", 7)) as (sf, jp):
+        tap = fleet._CHAOS_JOB_TAP
+        assert tap["fault"]["a"]["times"] == 2
+        assert tap["preempt"]["b"]["step"] == 7
+    assert fleet._CHAOS_JOB_TAP is None        # exception-safe disarm
+
+
+# ---------------------------------------------------------------------------
+# Env registry: the new knobs are known (and typed)
+# ---------------------------------------------------------------------------
+
+def test_ensemble_fleet_knobs_registered(monkeypatch):
+    from igg import _env
+
+    for name in ("IGG_ENSEMBLE_RETRIES", "IGG_ENSEMBLE_MAX_PENDING_PROBES",
+                 "IGG_FLEET_RETRIES", "IGG_FLEET_BACKOFF"):
+        assert name in _env._KNOWN
+    # Setting them trips no unrecognized-knob warning...
+    monkeypatch.setattr(_env, "_warned_unknown", False)
+    monkeypatch.setenv("IGG_FLEET_RETRIES", "5")
+    monkeypatch.setenv("IGG_ENSEMBLE_RETRIES", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert _env.integer("IGG_FLEET_RETRIES", 2) == 5
+    # ...and the accessors are typed: junk raises GridError naming the var.
+    monkeypatch.setenv("IGG_FLEET_BACKOFF", "soon")
+    with pytest.raises(igg.GridError, match="IGG_FLEET_BACKOFF"):
+        _env.number("IGG_FLEET_BACKOFF", 0.5)
+    # The defaults feed the tiers.
+    from igg.ensemble import _member_retries_default
+    from igg.fleet import _fleet_retries_default
+
+    assert _member_retries_default() == 1
+    assert _fleet_retries_default() == 5
