@@ -44,20 +44,49 @@ def _commit() -> "str | None":
         return None
 
 
+@functools.lru_cache(maxsize=1)
+def _toolchain() -> dict:
+    """The environment half of the provenance header: jax/jaxlib versions,
+    backend, device kind, process count.  Cached — the backend is queried
+    once per benchmark process."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except ImportError:   # jaxlib folded into jax on some builds
+        jaxlib_version = None
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "processes": int(jax.process_count()),
+    }
+
+
 def provenance() -> dict:
-    """Commit + timestamp + smoke flag stamped on every result line, so
-    checked-in artifacts are traceable to the code that produced them.
-    `smoke: true` (the default on a virtual CPU mesh) marks a quick
-    structural-validation run; a benchmark may override it for a
-    full-quality measured run — the `platform` field inside each record's
-    config still says where it ran, so CPU-mesh lines can never be
-    mistaken for accelerator evidence."""
+    """Commit + timestamp + smoke flag + toolchain header stamped on every
+    result line, so checked-in artifacts are traceable to the code AND the
+    environment that produced them (BENCH_r* rows become attributable:
+    which jax/jaxlib, which backend, which device kind, how many
+    processes, which git SHA).  `smoke: true` (the default on a virtual
+    CPU mesh) marks a quick structural-validation run; a benchmark may
+    override it for a full-quality measured run — the `platform` field
+    inside each record's config still says where it ran, so CPU-mesh
+    lines can never be mistaken for accelerator evidence.  Readers must
+    stay backfill-tolerant: rows written before this header lack the
+    `provenance` key (benchmarks/README.md, "Reading the provenance
+    header")."""
     import jax
 
     return {
         "commit": _commit(),
         "ts": int(__import__("time").time()),
         "smoke": jax.devices()[0].platform == "cpu",
+        "provenance": _toolchain(),
     }
 
 

@@ -5,6 +5,13 @@ Each benchmark runs in a fresh subprocess because virtual-device flags
 (`--xla_force_host_platform_device_count`) must be set before JAX initializes.
 Real-accelerator runs use the default backend; the virtual-mesh runs pin CPU.
 
+Every emitted line carries the `common.provenance()` header — git SHA,
+timestamp, smoke flag, and (round 12) the toolchain dict
+`{jax, jaxlib, backend, device_kind, processes}` — so checked-in
+BENCH_r* rows are attributable to the exact environment that produced
+them (backfill-tolerant reading: benchmarks/README.md, "Reading the
+provenance header").
+
 Usage: `python benchmarks/run_all.py [--quick]`.
 """
 

@@ -1,0 +1,158 @@
+"""The unified observability subsystem end to end: one chaos-injected
+failure, and the full post-mortem reconstructed from the telemetry
+artifacts ALONE.
+
+What `igg.telemetry` gives a production run (the same harness
+`tests/test_telemetry.py` drives, asserted here for `ci.sh`):
+
+1. a `run_resilient` under a NaN-corrupting kernel tier
+   (`igg.chaos.kernel_corrupt` — the deterministic-miscompile shape) with
+   a telemetry session attached: the watchdog detects, the loop rolls
+   back, the recurrence triggers the tier-demotion rung, and the run
+   completes on the demoted ladder.  The session directory then holds
+   `events_r0.jsonl` (timestamped rank-tagged records), a metrics
+   snapshot (`metrics_r0.jsonl` + Prometheus `metrics_r0.prom`), and a
+   Chrome-trace span export (`trace_r0.json`) — and the event stream
+   contains the watchdog → rollback → tier-demotion story IN ORDER;
+2. an unrecoverable failure (no checkpoint ring to roll back to): the
+   `ResilienceError` auto-dumps the flight recorder (`flight_r0.json`),
+   so the post-mortem has the last N events even though the run died;
+3. `python -m igg.telemetry merge` combines the rank-tagged streams into
+   one ordered stream (single-rank here; the multihost case is the same
+   invocation with more files).
+
+Run on TPU or on a virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/observed_run.py
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg.models import diffusion3d as d3
+
+TIER = "diffusion3d.mosaic"
+
+
+def main(nx=8, nt=40):
+    igg.init_global_grid(nx, nx, 128, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    me = igg.get_global_grid().me
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+    interpret = not igg.halo._is_tpu(igg.get_global_grid())
+
+    def say(msg):
+        if me == 0:
+            print(msg)
+
+    tdir = pathlib.Path(tempfile.gettempdir()) / "igg_observed_run"
+    ckdir = pathlib.Path(tempfile.gettempdir()) / "igg_observed_run_ck"
+    shutil.rmtree(tdir, ignore_errors=True)
+    shutil.rmtree(ckdir, ignore_errors=True)
+
+    # ---- 1. recovered failure: the timeline from the artifacts alone ----
+    say(f"observed run: NaN-corrupt kernel on {TIER}, telemetry -> {tdir}")
+    ref = None
+    step = d3.make_step(params, use_pallas=False, donate=False)
+    T = T0 + 0
+    for _ in range(nt):
+        T = step(T, Cp)
+    ref = np.asarray(T)
+
+    igg.degrade.reset()
+    step = d3.make_step(params, donate=False, pallas_interpret=interpret)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with igg.chaos.kernel_corrupt(TIER):
+            res = igg.run_resilient(
+                lambda s: {"T": step(s["T"], Cp)}, {"T": T0 + 0}, nt,
+                watch_every=10, checkpoint_dir=ckdir, checkpoint_every=10,
+                async_checkpoint=False, telemetry=tdir)
+    assert res.steps_done == nt
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+
+    events_file = tdir / "events_r0.jsonl"
+    assert events_file.is_file(), events_file
+    records = [json.loads(line) for line in events_file.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    # The watchdog -> rollback -> tier-demotion story, in order.
+    i_nan = kinds.index("nan_detected")
+    i_rb = kinds.index("rollback")
+    i_deg = kinds.index("tier_degraded")
+    assert i_nan < i_rb < i_deg, kinds
+    nan_step = records[i_nan]["step"]
+    rb = records[i_rb]
+    deg = records[i_deg]
+    assert deg["payload"]["tier"] == TIER
+    say(f"  timeline from events_r0.jsonl alone: NaN detected @ step "
+        f"{nan_step} -> rollback to {rb['payload']['path']} (attempt "
+        f"{rb['payload']['attempt']}) -> tier_degraded "
+        f"{deg['payload']['tier']} ({deg['payload']['reason']})")
+    # Metrics snapshot + Prometheus exposition + span trace all present.
+    snap = json.loads((tdir / "metrics_r0.jsonl").read_text()
+                      .splitlines()[-1])["metrics"]
+    assert any(k.startswith("igg_steps_total") for k in snap), sorted(snap)
+    assert any(k.startswith("igg_tier_dispatch_total") for k in snap)
+    prom = (tdir / "metrics_r0.prom").read_text()
+    assert "igg_steps_total" in prom
+    trace = json.loads((tdir / "trace_r0.json").read_text())
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    say(f"  metrics snapshot ({len(snap)} series), Prometheus exposition, "
+        f"and {len(trace['traceEvents'])} trace span(s) present")
+
+    # ---- 2. unrecoverable failure -> flight-recorder auto-dump ----
+    say("chaos: NaN with no ring to roll back to -> ResilienceError "
+        "auto-dumps the flight recorder")
+    igg.degrade.reset()
+    plan = igg.chaos.ChaosPlan(nan_at=[(7, "T")])
+    step2 = d3.make_step(params, use_pallas=False, donate=False)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            igg.run_resilient(lambda s: {"T": step2(s["T"], Cp)},
+                              {"T": T0 + 0}, nt, watch_every=10,
+                              telemetry=tdir, chaos=plan)
+        raise AssertionError("expected ResilienceError")
+    except igg.ResilienceError:
+        pass
+    flight = tdir / "flight_r0.json"
+    assert flight.is_file(), flight
+    dump = json.loads(flight.read_text())
+    assert any(r["kind"] == "nan_detected" for r in dump["events"])
+    say(f"  flight_r0.json present ({len(dump['events'])} events, reason: "
+        f"{dump['reason']!r})")
+
+    # ---- 3. the merge tool (single-controller invocation) ----
+    merged = tdir / "merged.jsonl"
+    out = subprocess.run(
+        [sys.executable, "-m", "igg.telemetry", "merge", str(merged),
+         str(tdir)],
+        capture_output=True, text=True,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent))
+    assert out.returncode == 0, out.stderr
+    merged_recs = [json.loads(line)
+                   for line in merged.read_text().splitlines()]
+    walls = [r["wall"] for r in merged_recs if "wall" in r]
+    assert walls == sorted(walls) and len(merged_recs) >= len(records)
+    say(f"  python -m igg.telemetry merge: {len(merged_recs)} records, "
+        f"wall-ordered")
+
+    shutil.rmtree(ckdir, ignore_errors=True)
+    say("observed_run: OK")
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

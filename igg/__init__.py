@@ -28,10 +28,14 @@ rollback-and-retry, preemption handling; fault injectors in `igg.chaos`),
 the verified tier-degradation ladder (`igg.degrade` — kernel
 quarantine with compile-failure capture, numeric verify-on-first-use
 against the pure-XLA composition truth, observable/resettable status),
-and the ensemble/fleet tier (`igg.run_ensemble` — M independent members
+the ensemble/fleet tier (`igg.run_ensemble` — M independent members
 in one compiled program with per-member fault isolation and quarantine;
 `igg.run_fleet` — a job queue drained onto whatever devices exist, with
-retry/backoff, a persistent journal, and elastic resume).
+retry/backoff, a persistent journal, and elastic resume), and the unified
+observability subsystem (`igg.telemetry` — one timestamped, rank-tagged
+event bus with a flight-recorder ring, a metrics registry with
+Prometheus exposition, zero-sync device-side step stats, and Chrome-trace
+spans; docs/observability.md).
 """
 
 from ._compat import install as _compat_install
@@ -100,8 +104,10 @@ from . import ensemble
 from . import fleet
 from . import profiling
 from . import resilience
+from . import telemetry
 from . import tools
 from . import vis
+from .telemetry import Telemetry
 
 __version__ = "0.1.0"
 
@@ -124,5 +130,6 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
+    "telemetry", "Telemetry",
     "time_steps", "__version__",
 ]

@@ -45,6 +45,17 @@ _KNOWN: Dict[str, str] = {
         "launcher-fault retries per fleet job before it is marked failed",
     "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
     "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
+    "IGG_TELEMETRY_DEVICE":
+        "0 disables mirroring trace spans onto the device timeline "
+        "(jax.profiler.TraceAnnotation)",
+    "IGG_TELEMETRY_DIR":
+        "default igg.telemetry session directory (setting it attaches "
+        "telemetry to every run loop)",
+    "IGG_TELEMETRY_FLIGHT_RECORDER":
+        "flight-recorder ring size (events kept for post-mortem dumps)",
+    "IGG_TELEMETRY_METRICS_EVERY":
+        "seconds between periodic metrics exports (0: at detach only)",
+    "IGG_TELEMETRY_SPANS": "0 disables host-side trace-span capture",
     "IGG_TPU_TESTS": "1 runs the TPU-only test files on the real backend",
     "IGG_VERIFY_KERNELS":
         "1 verifies every kernel tier against the XLA truth on first use",

@@ -76,12 +76,14 @@ import json
 import logging
 import pathlib
 import re
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import shared
+from . import telemetry as _telemetry
 from .shared import GridError, NDIMS
 
 __all__ = ["save_checkpoint", "save_checkpoint_sharded", "load_checkpoint",
@@ -301,6 +303,7 @@ def save_checkpoint(path, /, **fields) -> None:
             "run_resilient(sharded=True): per-process shard writes, no "
             "global assembly anywhere.")
 
+    t_start = time.monotonic()
     host: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
     for name, A in fields.items():
@@ -326,6 +329,15 @@ def save_checkpoint(path, /, **fields) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("igg_save_checkpoint")
+    # Observability (igg.telemetry): flat-format write latency + bytes
+    # (the assembled global payload — zero on non-root ranks).
+    dur = time.monotonic() - t_start
+    nbytes = int(sum(a.nbytes for a in host.values()))
+    _telemetry.counter("igg_checkpoint_bytes_total").inc(nbytes)
+    _telemetry.histogram("igg_checkpoint_write_seconds",
+                         format="flat").observe(dur)
+    _telemetry.emit("checkpoint_write", path=str(path), bytes=nbytes,
+                    seconds=round(dur, 6), format="flat")
 
 
 def load_checkpoint(path, /, *, redistribute: bool = False) -> Dict:
@@ -981,6 +993,8 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
     import shutil
     import uuid
 
+    t_start = time.monotonic()
+    written_bytes = 0   # this process's staged shard payload (pre-zip)
     proc0 = int(jax.process_index()) == 0
     staging = path.with_name(path.name + ".tmp")
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -994,7 +1008,9 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
         # wait below and be sealed — CRC-consistent but from the wrong
         # attempt — into the manifest.
         if staging.is_dir():
-            shutil.rmtree(staging)
+            # The clear can race a live peer's hello landing in the stale
+            # dir (hellos precede any ack); retry-swept, not fatal.
+            _rmtree_contended(staging)
         elif staging.exists():
             staging.unlink()
         staging.mkdir()
@@ -1025,6 +1041,7 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
                 _slabbed_get(refs[rank][name], _CHUNK_BYTES)))
             crcs[name] = _crc32(arr)
             host[name] = arr
+            written_bytes += arr.nbytes
         smeta = {"shard": rank, "coords": list(grid.cart_coords(rank)),
                  "dtypes": {n: dtypes[n] for n in host}, "crc32": crcs}
         _write_npz(staging / _shard_name(rank), {
@@ -1090,6 +1107,38 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
         # replay over an earlier, possibly poisoned, save of the same step)
         # carries a different token and keeps the wait pending.
         _await_commit(path, token)
+    # Observability (igg.telemetry): bytes staged by THIS process +
+    # end-to-end write latency, commit/handshake waits included.
+    dur = time.monotonic() - t_start
+    _telemetry.counter("igg_checkpoint_bytes_total").inc(written_bytes)
+    _telemetry.histogram("igg_checkpoint_write_seconds",
+                         format="sharded").observe(dur)
+    _telemetry.emit("checkpoint_write", path=str(path),
+                    bytes=int(written_bytes), seconds=round(dur, 6),
+                    format="sharded")
+
+
+def _rmtree_contended(path, attempts: int = 8) -> None:
+    """`shutil.rmtree` that survives a CONCURRENT file creation inside the
+    tree: clearing a dead attempt's staging directory can race a live
+    peer's hello write (peers publish their hello before any ack gates
+    them), which surfaces as ENOTEMPTY/EEXIST from the final rmdir.  Each
+    retry sweeps the newcomers too; anything else propagates."""
+    import errno
+    import shutil
+    import time as _t
+
+    for i in range(attempts):
+        try:
+            shutil.rmtree(path)
+            return
+        except FileNotFoundError:
+            return
+        except OSError as e:
+            if (e.errno not in (errno.ENOTEMPTY, errno.EEXIST)
+                    or i == attempts - 1):
+                raise
+            _t.sleep(0.01)
 
 
 def _read_shard_meta(p: pathlib.Path) -> dict:

@@ -60,6 +60,7 @@ import warnings
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import _env
+from . import telemetry as _telemetry
 from .shared import GridError
 
 __all__ = ["Admission", "Quarantine", "Tier", "Ladder", "status", "events",
@@ -197,6 +198,11 @@ def quarantine(tier: str, rung: int, reason: str,
                         "reason": reason, "error": text})
         warn = tier not in _warned
         _warned.add(tier)
+    # The unified bus (igg.telemetry): `events()` stays the ladder's own
+    # filtered view; the bus record adds timestamps/rank for post-mortems.
+    _telemetry.emit("tier_degraded", tier=tier, rung=rung, reason=reason,
+                    error=text)
+    _telemetry.counter("igg_tier_quarantined_total", tier=tier).inc()
     if warn:
         warnings.warn(
             f"igg.degrade: tier {tier!r} (rung {rung}) quarantined "
@@ -527,9 +533,11 @@ class Ladder:
                 else:
                     out.append(a)
             return tuple(out)
-        got = self._call(t, fn, scratch())
-        want = truth_fn(*scratch())
-        detail = _compare_outputs(got, want)
+        with _telemetry.span("degrade.verify_first_use", tier=t.name,
+                             family=self.family):
+            got = self._call(t, fn, scratch())
+            want = truth_fn(*scratch())
+            detail = _compare_outputs(got, want)
         if detail is not None:
             raise _VerifyMismatch(detail)
         with _lock:
@@ -541,6 +549,8 @@ class Ladder:
             _DISPATCHES += 1
             _ACTIVE[self.family] = tier_name
             _ACTIVE_STAMP[self.family] = _DISPATCHES
+        _telemetry.counter("igg_tier_dispatch_total", family=self.family,
+                           tier=tier_name).inc()
 
     def dispatch(self, *args):
         for t in self.tiers:

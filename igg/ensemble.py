@@ -76,6 +76,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import shared
+from . import telemetry as _telemetry
 from .shared import AXIS_NAMES, GridError
 from .resilience import Event, ResilienceError, _is_ready, _preempt, \
     clear_preemption, request_preemption
@@ -471,6 +472,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                  devices=None,
                  install_sigterm: bool = True,
                  on_event: Optional[Callable[[Event], None]] = None,
+                 telemetry=None,
                  chaos=None) -> EnsembleResult:
     """Drive M independent members of `step_fn` for `n_steps` steps in ONE
     compiled program with per-member fault isolation (module docstring for
@@ -502,6 +504,13 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     - `resume=True` loads the newest healthy generation elastically
       (different `dims`/device count included) and restores quarantine
       state from the sidecar.
+    - `telemetry`: unified observability (:mod:`igg.telemetry` — the
+      :func:`igg.run_resilient` contract: None/False/True/dir/session).
+      Events flow onto the process bus regardless; with a session
+      attached the run also emits per-window `step_stats` records with
+      aggregate member rates (piggybacked on the per-member watchdog's
+      async fetches — zero extra host syncs), exports metrics, and
+      auto-dumps the flight recorder on faults.
     - `chaos`: an :class:`igg.chaos.ChaosPlan`; member-targeted entries
       `(step, member, field)` poison one member's lane.
 
@@ -597,9 +606,26 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     def _emit(kind, step, **detail) -> Event:
         ev = Event(kind, step, detail)
         events.append(ev)
+        # The unified bus (igg.telemetry); `events` stays the per-run view.
+        _telemetry.emit(kind, step=step, run="ensemble", **detail)
         if on_event is not None:
             on_event(ev)
         return ev
+
+    # Unified telemetry session: attached before the resume scan so the
+    # earliest events reach the JSONL sink (the run_resilient pattern).
+    tel = _telemetry.as_session(telemetry)
+    tel_owns = tel is not None and not tel.attached
+    if tel_owns:
+        tel.attach()
+    _telemetry.emit("run_started", run="ensemble", n_steps=n_steps,
+                    members=members, packing=pk.name,
+                    watch_every=watch_every, steps_per_call=steps_per_call)
+    stats = _telemetry.StepStats("ensemble", members=members)
+    m_steps = _telemetry.counter("igg_steps_total", run="ensemble")
+    m_member_steps = _telemetry.counter("igg_member_steps_total")
+    m_rollbacks = _telemetry.counter("igg_rollbacks_total", run="ensemble")
+    m_quarantined = _telemetry.counter("igg_member_quarantined_total")
 
     valid = np.ones(members, dtype=bool)       # not quarantined
     retries = {m: 0 for m in range(members)}
@@ -611,58 +637,70 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
 
     steps_done = 0
     resumed_step = None
-    if resume and cdir is not None:
-        for s, p in reversed(_generations()):
-            meta = _read_sidecar(p) if p.is_dir() else None
-            if meta is None or int(meta.get("members", -1)) != members:
-                continue
-            active = [m for m in range(members)
-                      if m not in set(meta.get("quarantined", []))]
-            try:
-                cand_state, meta = _load_generation(
-                    p, pk, grid_fields, params, redistribute=True)
-            except GridError:
-                continue
-            ok = True
-            for k in grid_fields:
-                # Device-sliced to the active lanes: the host fetch is
-                # O(|active|), and a quarantined lane's NaNs never reject
-                # the candidate.
-                if active and not _finite(np.asarray(
-                        cand_state[k][np.asarray(active, dtype=np.int32)])):
-                    ok = False
-                    break
-            if not ok:
-                continue
-            state = cand_state
-            steps_done = resumed_step = s
-            for m in meta.get("quarantined", []):
-                valid[int(m)] = False
-            for m, r in (meta.get("retries", {}) or {}).items():
-                retries[int(m)] = int(r)
-            if steps_done % steps_per_call != 0:
-                raise GridError(
-                    f"run_ensemble(resume=True): generation {p.name} is at "
-                    f"step {steps_done}, not a multiple of "
-                    f"steps_per_call={steps_per_call}.")
-            _emit("resume", steps_done, path=str(p),
-                  quarantined=sorted(int(m) for m in
-                                     np.nonzero(~valid)[0]))
-            break
-        if resumed_step is None:
-            # The scan matched nothing: every existing generation is
-            # unusable for THIS run (wrong member count, no sidecar, or
-            # active lanes non-finite).  The run starts fresh at step 0 —
-            # and like a fresh run it must own its ring: left in place,
-            # the stale high-step generations would win every
-            # newest-`ring` prune (deleting each fresh low-step write
-            # immediately) and could never serve a rollback.
-            for _, old in _generations():
-                ckpt.remove_generation(old)
+    # Pre-loop failures (resume scan, stale-ring sweep, program builds)
+    # must not leak the run-owned session into the process-global sink
+    # list: dump + detach + re-raise (the main loop's own except/finally
+    # takes over once it is entered).
+    try:
+        if resume and cdir is not None:
+            for s, p in reversed(_generations()):
+                meta = _read_sidecar(p) if p.is_dir() else None
+                if meta is None or int(meta.get("members", -1)) != members:
+                    continue
+                active = [m for m in range(members)
+                          if m not in set(meta.get("quarantined", []))]
+                try:
+                    cand_state, meta = _load_generation(
+                        p, pk, grid_fields, params, redistribute=True)
+                except GridError:
+                    continue
+                ok = True
+                for k in grid_fields:
+                    # Device-sliced to the active lanes: the host fetch
+                    # is O(|active|), and a quarantined lane's NaNs never
+                    # reject the candidate.
+                    if active and not _finite(np.asarray(
+                            cand_state[k][np.asarray(active,
+                                                     dtype=np.int32)])):
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                state = cand_state
+                steps_done = resumed_step = s
+                for m in meta.get("quarantined", []):
+                    valid[int(m)] = False
+                for m, r in (meta.get("retries", {}) or {}).items():
+                    retries[int(m)] = int(r)
+                if steps_done % steps_per_call != 0:
+                    raise GridError(
+                        f"run_ensemble(resume=True): generation {p.name} "
+                        f"is at step {steps_done}, not a multiple of "
+                        f"steps_per_call={steps_per_call}.")
+                _emit("resume", steps_done, path=str(p),
+                      quarantined=sorted(int(m) for m in
+                                         np.nonzero(~valid)[0]))
+                break
+            if resumed_step is None:
+                # The scan matched nothing: every existing generation is
+                # unusable for THIS run (wrong member count, no sidecar,
+                # or active lanes non-finite).  The run starts fresh at
+                # step 0 — and like a fresh run it must own its ring:
+                # left in place, the stale high-step generations would
+                # win every newest-`ring` prune (deleting each fresh
+                # low-step write immediately) and could never serve a
+                # rollback.
+                for _, old in _generations():
+                    ckpt.remove_generation(old)
 
-    estep = _build_step(step_fn, pk, keys, ndims, steps_per_call)
-    eprobe = (_build_probe(pk, watch, ndims)
-              if (watch and watch_every) else None)
+        estep = _build_step(step_fn, pk, keys, ndims, steps_per_call)
+        eprobe = (_build_probe(pk, watch, ndims)
+                  if (watch and watch_every) else None)
+    except BaseException as e:
+        _telemetry._auto_dump(f"run_ensemble: {type(e).__name__}: {e}")
+        if tel_owns:
+            tel.detach()
+        raise
 
     pending: deque = deque()       # (probe_step, device counts, mode_snapshot)
     last_good = steps_done         # newest step probe-confirmed for all active
@@ -690,8 +728,10 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
 
     def _save_gen(step) -> None:
         nonlocal last_ckpt, last_ckpt_step, gen_stale
-        p = _save_generation(_gen_path(step), state, grid_fields, params,
-                             grid, _sidecar_meta(step))
+        with _telemetry.span("checkpoint.generation", step=step,
+                             path=str(_gen_path(step)), run="ensemble"):
+            p = _save_generation(_gen_path(step), state, grid_fields,
+                                 params, grid, _sidecar_meta(step))
         _prune(last_good)
         if step >= last_ckpt_step:
             last_ckpt, last_ckpt_step = p, step
@@ -742,12 +782,18 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                 # (or newest below) this step is a protected rollback
                 # target (the round-8 ring-prune guarantee, per member).
                 last_good = max(last_good, step_p)
+            # Step stats ride THIS fetch (igg.telemetry): the probe was
+            # already materialized for the verdict — the rate telemetry
+            # (incl. the aggregate member rate) costs a host timestamp,
+            # zero additional syncs.
+            stats.fetched(step_p, pos, active_members=int(lanes.sum()))
         return None
 
     def _quarantine(ms, step, reason) -> None:
         for m in ms:
             if valid[m]:
                 valid[m] = False
+                m_quarantined.inc()
                 _emit("member_quarantined", step, member=int(m),
                       reason=reason, retries=int(retries[m]))
         if not valid.any():
@@ -832,7 +878,10 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
             return None
         s0, gen, loaded, meta = target
         pending.clear()
-        _restore_lanes(gen, lanes, loaded, meta)
+        m_rollbacks.inc()
+        with _telemetry.span("ensemble.member_rollback", step=ev.step,
+                             target_step=s0, lanes=len(lanes)):
+            _restore_lanes(gen, lanes, loaded, meta)
         _emit("member_rollback", s0, members=lanes, from_step=ev.step,
               path=str(gen),
               attempts={str(m): int(retries[m]) for m in lanes})
@@ -910,12 +959,16 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
 
             _dispatch(mask_dev)
             pos += steps_per_call
+            m_member_steps.inc(steps_per_call * int(_stepping().sum()))
             if not in_catchup:
                 steps_done = pos
+                m_steps.inc(steps_per_call)
 
             fail = None
             if eprobe is not None and pos % watch_every == 0:
                 _enqueue_probe(pos, _stepping())
+            if tel is not None:
+                tel.maybe_export_metrics()   # one clock read when idle
             if fail is None:
                 fail = _poll_probes()
             if fail is not None:
@@ -955,6 +1008,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                     })
             _emit("preempt", steps_done,
                   path=str(last_ckpt) if last_ckpt else None)
+            _telemetry._auto_dump(f"preempt at step {steps_done}")
         elif checkpoint_every and (steps_done % checkpoint_every != 0
                                    or gen_stale):
             # Off-cadence front, or a tail-window rollback replayed PAST
@@ -969,6 +1023,12 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
             _write_sidecar(last_ckpt, {
                 **_sidecar_meta(steps_done), "format": _SIDECAR_FORMAT,
                 "params": old.get("params", {})})
+    except BaseException as e:
+        # ResilienceError (all members quarantined) and any unhandled
+        # escape: dump the flight recorder wherever a sink is configured,
+        # then re-raise untouched.
+        _telemetry._auto_dump(f"run_ensemble: {type(e).__name__}: {e}")
+        raise
     finally:
         if installed:
             signal.signal(signal.SIGTERM, old_handler)
@@ -978,6 +1038,16 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
             # landed after this run's last check — the fleet must still
             # see it and stop draining.
             clear_preemption()
+        _telemetry.emit("run_finished", step=steps_done, run="ensemble",
+                        preempted=preempted,
+                        quarantined=sorted(int(m)
+                                           for m in np.nonzero(~valid)[0]))
+        if tel is not None:
+            try:
+                tel.export_metrics()
+            finally:
+                if tel_owns:
+                    tel.detach()
 
     return EnsembleResult(
         state=state, members=members, steps_done=steps_done,

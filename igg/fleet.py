@@ -59,6 +59,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import shared
+from . import telemetry as _telemetry
 from .shared import GridError, NDIMS
 from .resilience import Event, ResilienceError, clear_preemption, \
     preemption_requested, request_preemption
@@ -67,6 +68,14 @@ __all__ = ["Job", "JobOutcome", "FleetResult", "run_fleet", "plan_dims"]
 
 _JOURNAL = "journal.json"
 _JOURNAL_FORMAT = "igg-fleet-journal-v1"
+
+# The scheduler-origin event kinds (everything else arriving at the
+# fleet's emitter is a FORWARDED run_ensemble event — already on the
+# telemetry bus from inside the run).
+_SCHEDULER_KINDS = frozenset({
+    "job_started", "job_done", "job_failed", "job_gave_up",
+    "job_requeued", "job_preempted", "job_resumed",
+})
 
 # Chaos seam (igg.chaos.scheduler_fault / job_preempt_at): a dict
 # {"fault": {job: {"times": n, "message": ...}},
@@ -259,15 +268,20 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
               resume: bool = False, max_job_retries: Optional[int] = None,
               backoff: Optional[float] = None,
               install_sigterm: bool = True,
-              on_event: Optional[Callable[[Event], None]] = None
-              ) -> FleetResult:
+              on_event: Optional[Callable[[Event], None]] = None,
+              telemetry=None) -> FleetResult:
     """Drain `jobs` in order onto the live devices (module docstring for
     the full contract).  The caller must NOT hold an initialized grid —
     the scheduler owns grid lifecycle per job.  `resume=True` reconciles
     against the journal under `workdir`: finished jobs are skipped,
     interrupted ones resume from their checkpoint rings (elastically, on
     whatever `devices` now exist).  Returns a :class:`FleetResult`;
-    `on_event` receives every job-scoped event (detail carries `job`)."""
+    `on_event` receives every job-scoped event (detail carries `job`).
+    `telemetry` attaches a unified observability session
+    (:mod:`igg.telemetry` — the :func:`igg.run_resilient` contract) for
+    the WHOLE drain: job lifecycle spans, a fleet queue-depth gauge,
+    per-status job counters, and every job-scoped event on one
+    rank-tagged JSONL stream."""
     import jax
 
     if shared.grid_is_initialized():
@@ -297,9 +311,31 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
 
     def _emit(kind, step, **detail) -> Event:
         ev = Event(kind, step, detail)
+        # The unified bus (igg.telemetry): only the SCHEDULER's own kinds
+        # are emitted here — nested run_ensemble events reach the bus from
+        # inside the run (run="ensemble", same record), and re-emitting
+        # the forwarded copy would double every incident in the stream.
+        if kind in _SCHEDULER_KINDS:
+            _telemetry.emit(kind, step=step, run="fleet", **detail)
         if on_event is not None:
             on_event(ev)
         return ev
+
+    # Unified telemetry session for the whole drain.
+    tel = _telemetry.as_session(telemetry)
+    tel_owns = tel is not None and not tel.attached
+    if tel_owns:
+        tel.attach()
+    _telemetry.emit("run_started", run="fleet", jobs=len(jobs),
+                    resume=resume)
+    m_queue = _telemetry.gauge("igg_fleet_queue_depth")
+
+    def _queue_depth() -> int:
+        """Jobs not yet terminal this drain ('done'/'failed' are terminal;
+        'queued'/'running'/'preempted' still owe work)."""
+        done = sum(1 for o in outcomes.values()
+                   if o.status in ("done", "failed"))
+        return len(jobs) - done
 
     def _jrec(job: Job) -> dict:
         rec = journal["jobs"].setdefault(job.name, {
@@ -322,6 +358,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
             pass
 
     fleet_preempted = False
+    m_queue.set(_queue_depth())
     try:
         for job in jobs:
             rec = _jrec(job)
@@ -329,6 +366,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
                 outcomes[job.name] = JobOutcome(
                     status="done", attempts=rec["attempts"],
                     dims=tuple(rec["dims"]) if rec["dims"] else None)
+                m_queue.set(_queue_depth())
                 continue
             if fleet_preempted or preemption_requested():
                 fleet_preempted = True
@@ -337,10 +375,15 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
                 break
             resume_job = resume and rec["status"] in ("preempted",
                                                       "running")
-            outcome = _run_job(job, workdir / "jobs" / job.name, devs,
-                               resume_job, max_job_retries, backoff,
-                               _emit, _transition, rec)
+            with _telemetry.span("fleet.job", job=job.name,
+                                 resume=resume_job):
+                outcome = _run_job(job, workdir / "jobs" / job.name, devs,
+                                   resume_job, max_job_retries, backoff,
+                                   _emit, _transition, rec, tel)
             outcomes[job.name] = outcome
+            _telemetry.counter("igg_fleet_jobs_total",
+                               status=outcome.status).inc()
+            m_queue.set(_queue_depth())
             # Stop draining on an in-run preemption, a preemption that
             # interrupted a launcher-fault backoff (the job went back to
             # 'queued'), or a SIGTERM that landed after the job's run
@@ -356,6 +399,11 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
                     attempts=journal["jobs"].get(job.name,
                                                  {}).get("attempts", 0))
         _write_journal(jpath, journal)
+        if fleet_preempted:
+            _telemetry._auto_dump("fleet drain preempted")
+    except BaseException as e:
+        _telemetry._auto_dump(f"run_fleet: {type(e).__name__}: {e}")
+        raise
     finally:
         if installed:
             signal.signal(signal.SIGTERM, old_handler)
@@ -364,6 +412,14 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
             # clearing here would swallow a SIGTERM that landed after
             # this drain's last check.
             clear_preemption()
+        _telemetry.emit("run_finished", run="fleet",
+                        preempted=fleet_preempted)
+        if tel is not None:
+            try:
+                tel.export_metrics()
+            finally:
+                if tel_owns:
+                    tel.detach()
 
     return FleetResult(jobs=outcomes, preempted=fleet_preempted,
                        journal=jpath)
@@ -371,7 +427,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
 
 def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
              max_job_retries: int, backoff: float, _emit, _transition,
-             rec) -> JobOutcome:
+             rec, tel) -> JobOutcome:
     """Launch one job with retry/exponential-backoff around LAUNCHER
     faults (grid init, decomposition planning, state build, compile) —
     a fault inside the run itself is the ensemble tier's problem."""
@@ -433,6 +489,12 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                 job_event(Event("job_started", 0,
                                 {"attempt": attempt, "dims": list(dims),
                                  "devices": ndev, "resume": resume_job}))
+                # The drain's session is passed THROUGH (already attached,
+                # so the run neither re-attaches nor detaches it, and the
+                # periodic metrics export runs at the watch cadence);
+                # telemetry=False when the drain has none — the nested run
+                # must not auto-attach a second session off
+                # IGG_TELEMETRY_DIR onto the same files.
                 res = run_ensemble(
                     step_fn, states, job.n_steps, members=job.members,
                     watch_every=job.watch_every,
@@ -442,6 +504,7 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                     resume=resume_job, steps_per_call=job.steps_per_call,
                     packing=job.packing, devices=devs,
                     install_sigterm=False, on_event=job_event,
+                    telemetry=tel if tel is not None else False,
                     chaos=chaos)
                 if resume_job and any(e.kind == "resume"
                                       for e in res.events):

@@ -1191,6 +1191,7 @@ def update_halo(*fields, assembly=None):
         return jax.jit(sm, donate_argnums=tuple(range(len(fields))))
 
     from . import degrade
+    from . import telemetry as _telemetry
 
     fn = _compiled.get(key)
     first = fn is None
@@ -1199,6 +1200,30 @@ def update_halo(*fields, assembly=None):
     writer_possible = (
         assembly is None and (_is_tpu(grid) or _FORCE_WRITER_INTERPRET)
         and not degrade.is_quarantined(degrade.HALO_WRITER_TIER))
+    if first:
+        # Observability (igg.telemetry): one writer-election record per
+        # compiled program — which assembly tier this program was traced
+        # against (quarantine flips re-trace, emitting a fresh record).
+        _telemetry.emit(
+            "halo_writer_election", assembly=assembly,
+            writer_possible=bool(writer_possible), n_fields=len(fields),
+            quarantined=degrade.is_quarantined(degrade.HALO_WRITER_TIER))
+    # Halo traffic: every exchanged boundary plane of this call — per
+    # DEVICE, two sides per moving dim of a local-block cross-section,
+    # summed over the mesh (the dim classification and plane sizes are
+    # local-shape questions: `active_dims`/`ol_of_local` are defined on
+    # per-device blocks, not the stacked global array).  Pure host
+    # arithmetic, counted once per call.
+    plane_bytes = 0
+    for A, ls in zip(fields, local_shapes):
+        elems = 1
+        for v in ls:
+            elems *= int(v)
+        itemsize = A.dtype.itemsize
+        for d, _ in moving_dims(active_dims(ls, grid), grid):
+            plane_bytes += (2 * (elems // int(ls[d])) * itemsize
+                            * grid.nprocs)
+    _telemetry.counter("igg_halo_plane_bytes_total").inc(plane_bytes)
     try:
         if first and writer_possible:
             # Chaos seam (igg.chaos.kernel_compile_fail("halo.writer")).

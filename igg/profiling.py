@@ -3,12 +3,26 @@
 The reference's observability is `tic()/toc()` only (SURVEY §5,
 `/root/reference/src/tools.jl:228-234`); on TPU the idiomatic extra is an XLA
 profiler trace viewable in TensorBoard/Perfetto (per-op device timelines,
-collective overlap, HBM traffic).
+collective overlap, HBM traffic).  Host-side spans recorded through
+:mod:`igg.telemetry` are mirrored onto the same device timeline via
+`jax.profiler.TraceAnnotation`, so a trace captured here lines up with the
+unified event stream.
 """
 
 from __future__ import annotations
 
 import contextlib
+import pathlib
+import threading
+
+from .shared import GridError
+
+# Re-entrancy guard: `jax.profiler.start_trace` raises mid-flight when a
+# trace is already active, which used to surface as an opaque runtime error
+# AFTER the enclosing trace was silently broken.  One trace at a time,
+# stated upfront.
+_lock = threading.Lock()
+_active_logdir = None
 
 
 @contextlib.contextmanager
@@ -20,19 +34,50 @@ def trace(logdir: str = "/tmp/igg_trace"):
                 T = step(T, Cp)
 
     Open the result with TensorBoard's profile plugin or ui.perfetto.dev.
+    The log directory is created (parents included) if missing; nesting a
+    second `trace()` inside an active one raises :class:`igg.GridError`
+    immediately instead of corrupting the in-flight capture.  Entry and
+    exit are recorded on the unified event bus (`trace_started` /
+    `trace_stopped`, :mod:`igg.telemetry`).
     """
     import jax
 
-    jax.profiler.start_trace(logdir)
+    from . import telemetry as _telemetry
+
+    global _active_logdir
+    with _lock:
+        if _active_logdir is not None:
+            raise GridError(
+                f"igg.profiling.trace: a trace is already active "
+                f"(logdir {_active_logdir!r}) — traces do not nest; close "
+                f"the enclosing trace first.")
+        _active_logdir = str(logdir)
+    try:
+        # A missing parent used to crash start_trace deep inside the
+        # profiler plugin; create the whole path upfront.
+        pathlib.Path(logdir).mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+    except BaseException:
+        with _lock:
+            _active_logdir = None
+        raise
+    _telemetry.emit("trace_started", logdir=str(logdir))
     try:
         yield logdir
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            with _lock:
+                _active_logdir = None
+            _telemetry.emit("trace_stopped", logdir=str(logdir))
 
 
 def annotate(name: str):
     """Named region that shows up on the profiler timeline (wraps
-    `jax.profiler.TraceAnnotation`)."""
+    `jax.profiler.TraceAnnotation`).  :func:`igg.telemetry.span` builds on
+    the same annotation and ALSO records the region on the host-side event
+    bus — prefer it when you want both."""
     import jax
 
     return jax.profiler.TraceAnnotation(name)

@@ -87,6 +87,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import shared
+from . import telemetry as _telemetry
 from .shared import AXIS_NAMES, GridError
 
 __all__ = ["run_resilient", "RunResult", "Event", "ResilienceError",
@@ -369,6 +370,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   async_checkpoint: bool = True,
                   install_sigterm: bool = True,
                   on_event: Optional[Callable[[Event], None]] = None,
+                  telemetry=None,
                   chaos=None) -> RunResult:
     """Drive `state = step_fn(state)` for `n_steps` steps with a device-side
     NaN/Inf watchdog, a rolling checkpoint ring, rollback-and-retry, and
@@ -410,6 +412,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
       a detection with no healthy generation (or no ring configured).
     - `resume=True` first scans `checkpoint_dir` for the newest healthy
       generation and continues from its step.
+    - `telemetry`: unified observability (:mod:`igg.telemetry`) — None
+      (default: on only when `IGG_TELEMETRY_DIR` is set), a directory
+      path, a :class:`igg.telemetry.Telemetry` session, or False (off).
+      Every run event additionally flows onto the process event bus
+      regardless (flight recorder + any attached session);
+      `RunResult.events` stays the per-run filtered view.  With a session
+      attached the run also emits per-window `step_stats` records
+      piggybacked on the watchdog's async fetches (zero extra host
+      syncs), exports metrics snapshots, and auto-dumps the flight
+      recorder on `ResilienceError`/preemption/unhandled escapes.
     - `chaos`: an :class:`igg.chaos.ChaosPlan` for deterministic fault
       injection (CI/testing only).
 
@@ -465,9 +477,15 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
 
     events: List[Event] = []
 
-    def _emit(kind, step, **detail) -> Event:
+    def _emit(kind, step, _bus=True, **detail) -> Event:
         ev = Event(kind, step, detail)
         events.append(ev)
+        # The unified bus (igg.telemetry): same record, timestamped and
+        # rank-tagged — `events` stays the per-run filtered view.
+        # `_bus=False` keeps an event in the per-run view only, for kinds
+        # whose authoritative bus record another subsystem just emitted.
+        if _bus:
+            _telemetry.emit(kind, step=step, run="resilient", **detail)
         if on_event is not None:
             on_event(ev)
         return ev
@@ -478,29 +496,52 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     # `deterministic_only` below), so the collective is safe.
     dist_verify = jax.process_count() > 1
 
+    # Unified telemetry (igg.telemetry): attach the session BEFORE the
+    # resume scan so the run's earliest events reach the JSONL sink too.
+    tel = _telemetry.as_session(telemetry)
+    tel_owns = tel is not None and not tel.attached
+    if tel_owns:
+        tel.attach()
+    _telemetry.emit("run_started", run="resilient", n_steps=n_steps,
+                    watch_every=watch_every, steps_per_call=steps_per_call)
+    stats = _telemetry.StepStats("resilient")
+    m_steps = _telemetry.counter("igg_steps_total", run="resilient")
+    m_rollbacks = _telemetry.counter("igg_rollbacks_total", run="resilient")
+
     steps_done = 0
     resumed_step = None
-    if resume and cdir is not None:
-        found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True,
-                                       distributed=dist_verify)
-        if found is not None:
-            # redistribute=True makes the resume ELASTIC: a generation
-            # written under a different dims/device count is re-tiled onto
-            # the live decomposition (on a matching geometry it is the
-            # plain 1:1 restore — redistribute only engages on mismatch).
-            state = ckpt.load_checkpoint(found, redistribute=True)
-            steps_done = resumed_step = ckpt.checkpoint_step(found) or 0
-            if steps_done % steps_per_call != 0:
-                raise GridError(
-                    f"run_resilient(resume=True): generation {found.name} "
-                    f"is at step {steps_done}, not a multiple of "
-                    f"steps_per_call={steps_per_call} — the resumed walk "
-                    f"would miss every watch/checkpoint boundary and "
-                    f"overshoot n_steps.  Resume with the steps_per_call "
-                    f"the checkpoint was written under.")
-            _emit("resume", steps_done, path=str(found))
-
-    probe = _make_probe() if (watch and watch_every) else None
+    try:
+        if resume and cdir is not None:
+            found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True,
+                                           distributed=dist_verify)
+            if found is not None:
+                # redistribute=True makes the resume ELASTIC: a generation
+                # written under a different dims/device count is re-tiled
+                # onto the live decomposition (on a matching geometry it is
+                # the plain 1:1 restore — redistribute only engages on
+                # mismatch).
+                state = ckpt.load_checkpoint(found, redistribute=True)
+                steps_done = resumed_step = ckpt.checkpoint_step(found) or 0
+                if steps_done % steps_per_call != 0:
+                    raise GridError(
+                        f"run_resilient(resume=True): generation "
+                        f"{found.name} "
+                        f"is at step {steps_done}, not a multiple of "
+                        f"steps_per_call={steps_per_call} — the resumed "
+                        f"walk "
+                        f"would miss every watch/checkpoint boundary and "
+                        f"overshoot n_steps.  Resume with the "
+                        f"steps_per_call "
+                        f"the checkpoint was written under.")
+                _emit("resume", steps_done, path=str(found))
+        probe = _make_probe() if (watch and watch_every) else None
+    except BaseException as e:
+        # A pre-loop failure must not leak the run-owned session into the
+        # process-global sink list.
+        _telemetry._auto_dump(f"run_resilient: {type(e).__name__}: {e}")
+        if tel_owns:
+            tel.detach()
+        raise
     pending: deque = deque()   # (probe_step, device-resident (nf,) counts)
     retries = 0
     last_fail = None           # (kind, step) of the previous rollback cause
@@ -555,10 +596,12 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         ones (the sharded save is filesystem-coordinated: no device
         collectives, so it is thread-safe)."""
         p = _gen_path(step)
-        if sharded:
-            ckpt.save_checkpoint_sharded(p, **fields)
-        else:
-            ckpt.save_checkpoint(p, **fields)
+        with _telemetry.span("checkpoint.generation", step=step,
+                             path=str(p)):
+            if sharded:
+                ckpt.save_checkpoint_sharded(p, **fields)
+            else:
+                ckpt.save_checkpoint(p, **fields)
         _prune(good_until)
         return p
 
@@ -579,7 +622,11 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         rollback scan, the final preemption generation, and end of run."""
         if writer is None:
             return
-        done, errs = writer.drain() if drain else writer.poll()
+        if drain:
+            with _telemetry.span("checkpoint.drain", step=steps_done):
+                done, errs = writer.drain()
+        else:
+            done, errs = writer.poll()
         for step_w, p, background in done:
             _record_gen(step_w, p, background=background)
         for step_w, e in errs:
@@ -631,6 +678,10 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 pending.clear()
                 return _emit("nan_detected", step_p, counts=bad)
             last_good = max(last_good, step_p)
+            # Step stats piggyback on THIS fetch (igg.telemetry): the
+            # probe was already materialized for the verdict, so the rate
+            # telemetry costs a host timestamp — zero additional syncs.
+            stats.fetched(step_p, steps_done)
         return None
 
     def _rollback(ev: Event) -> None:
@@ -656,7 +707,10 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                            f"bit-exact rollback",
                 since=run_stamp)
             for tname in demoted:
-                _emit("tier_degraded", ev.step, tier=tname,
+                # degrade.quarantine (inside demote_active) just emitted
+                # the authoritative tier_degraded bus record — this one is
+                # the per-run view's step-anchored copy only.
+                _emit("tier_degraded", ev.step, _bus=False, tier=tname,
                       reason="nan_recurrence")
         last_fail = (ev.kind, ev.step)
         if not demoted:
@@ -701,7 +755,10 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 f"checkpoint generation exists under {cdir} to roll back "
                 f"to.", events)
         pending.clear()
-        state = ckpt.load_checkpoint(target[1])
+        m_rollbacks.inc()
+        with _telemetry.span("resilience.rollback", step=ev.step,
+                             target_step=target[0]):
+            state = ckpt.load_checkpoint(target[1])
         steps_done = target[0]
         synced.clear()
         synced.add(steps_done)   # the loaded generation IS the state now
@@ -794,6 +851,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                         "async writes).  (Warned once per run.)",
                         stacklevel=2)
                 steps_done += steps_per_call
+                m_steps.inc(steps_per_call)
                 fail = None
                 if probe is not None and steps_done % watch_every == 0:
                     pending.append(
@@ -813,6 +871,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     # entry/rollback/preemption generations stay sync.
                     _save_gen(steps_done, sync=False)
                 _merge_writer()   # cheap: a deque pop, no blocking
+                if tel is not None:
+                    tel.maybe_export_metrics()   # one clock read when idle
             if preempted:
                 break
             # End of the run: probe the tail window (if the final step is
@@ -865,6 +925,15 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     _save_gen(steps_done)
             _emit("preempt", steps_done,
                   path=str(last_ckpt) if last_ckpt else None)
+            # Post-mortems always have the tail of the story: SIGTERM is
+            # one of the flight recorder's auto-dump triggers.
+            _telemetry._auto_dump(f"preempt at step {steps_done}")
+    except BaseException as e:
+        # ResilienceError, the retry-budget exhaustion path, and any
+        # unhandled escape: dump the flight recorder wherever a sink is
+        # configured, then re-raise untouched.
+        _telemetry._auto_dump(f"run_resilient: {type(e).__name__}: {e}")
+        raise
     finally:
         if writer is not None:
             try:
@@ -874,6 +943,14 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         if installed:
             signal.signal(signal.SIGTERM, old_handler)
         clear_preemption()
+        _telemetry.emit("run_finished", step=steps_done, run="resilient",
+                        preempted=preempted, retries=retries)
+        if tel is not None:
+            try:
+                tel.export_metrics()
+            finally:
+                if tel_owns:
+                    tel.detach()
 
     return RunResult(state=state, steps_done=steps_done, retries=retries,
                      preempted=preempted, events=events, checkpoint=last_ckpt)

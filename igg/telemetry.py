@@ -1,0 +1,793 @@
+"""igg.telemetry — the unified observability subsystem: one event bus,
+one metrics registry, device-side step stats, and trace spans for the
+whole stack.
+
+The reference's entire observability story is the barrier-synchronized
+`tic()/toc()` pair (`/root/reference/src/tools.jl:228-234`); igg's
+resilience/degradation/ensemble/fleet tiers (PRs 3-6) outgrew that and
+each grew its own event shape (`RunResult.events`,
+`igg.degrade.events()`, the fleet journal, ensemble sidecars) — four
+schemas, no timestamps, no rank tags, no metrics, no way to answer "why
+was job 14 slow" after the fact.  This module is the single layer they
+all emit into (the TPU-CFD exemplar of arXiv:2108.11076 treats on-device
+diagnostics-without-host-sync as a first-class design axis):
+
+- **Event bus.**  :func:`emit` stamps every incident as a typed
+  :class:`Record` `(t, wall, process, kind, step, payload)` — `t` is
+  `time.monotonic()` (ordering within a process), `wall` is epoch
+  seconds (merging across processes), `process` the controller rank.
+  Every record lands in the bounded in-memory **flight recorder** ring
+  (always on — a deque append, no I/O) and, when a :class:`Telemetry`
+  session is attached, in that session's rank-tagged
+  `events_r<rank>.jsonl` sink.  The run loops
+  (:func:`igg.run_resilient`, :func:`igg.run_ensemble`,
+  :func:`igg.run_fleet`), the degradation ladder, the checkpoint layer,
+  and the halo engine all emit here; `RunResult.events` /
+  `igg.degrade.events()` remain as filtered per-run views for API
+  compatibility.
+
+- **Flight recorder.**  The ring keeps the last N records
+  (`IGG_TELEMETRY_FLIGHT_RECORDER`, default 512) so a post-mortem always
+  has the tail of the story.  It is auto-dumped
+  (`flight_r<rank>.json`) on :class:`igg.ResilienceError`, on
+  SIGTERM/preemption, and on any exception escaping a run loop —
+  :func:`dump_flight_recorder` dumps it on demand.
+
+- **Metrics registry.**  :func:`counter` / :func:`gauge` /
+  :func:`histogram` get-or-create named instruments (optional labels);
+  :func:`snapshot` returns the registry as a plain dict,
+  :func:`prometheus_text` renders the Prometheus text exposition.  A
+  session exports both periodically (`metrics_r<rank>.jsonl`,
+  `metrics_r<rank>.prom`; cadence `IGG_TELEMETRY_METRICS_EVERY` seconds,
+  checked at the run loops' watch cadence) and once at detach.  The
+  stack maintains: steps run, rollbacks, checkpoint bytes + write
+  latency, halo plane bytes, per-tier dispatch counts, quarantines,
+  fleet queue depth, watchdog fetch lag (docs/observability.md for the
+  full name list).
+
+- **Device-side step stats, zero hot-loop host syncs.**  The watchdog
+  probes of `run_resilient`/`run_ensemble` are already fetched
+  asynchronously (`is_ready()` polling); the bus piggybacks on that
+  channel: each healthy probe fetch is host-timestamped, and the delta
+  between consecutive fetches yields per-window `step_stats` records
+  (steps/s, ms/step, watchdog fetch lag; per-member aggregate rates
+  under `run_ensemble`) — live rate telemetry that costs NO additional
+  device→host synchronization (asserted by the sentinel test in
+  `tests/test_telemetry.py` and the `telemetry_overhead` row of
+  `benchmarks/resilience_overhead.py`, < 1% contract).
+
+- **Trace spans.**  :func:`span` records a named host-side region
+  (checkpoint write/drain, rollback, halo compile, verify-first-use,
+  fleet job lifecycle) as a `span` record and mirrors it onto the
+  device timeline via `jax.profiler.TraceAnnotation` (so spans line up
+  with the XLA profiler trace of :func:`igg.profiling.trace`);
+  :func:`export_chrome_trace` renders spans as Chrome-trace/Perfetto
+  JSON (a session writes `trace_r<rank>.json` at detach).
+
+- **Multihost merge.**  `python -m igg.telemetry merge out.jsonl
+  dir-or-files...` merge-sorts rank-tagged JSONL streams by wall time
+  into one stream for cross-rank post-mortems.
+
+A session is a directory::
+
+    with igg.telemetry.Telemetry("/tmp/run1") as tel:
+        igg.run_resilient(step, state, nt, telemetry=tel, ...)
+
+or just ``run_resilient(..., telemetry="/tmp/run1")`` (the run owns the
+session), or ``IGG_TELEMETRY_DIR=/tmp/run1`` (every run auto-attaches).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+import os
+import pathlib
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .shared import GridError
+
+__all__ = [
+    "Record", "Telemetry", "emit", "span", "counter", "gauge", "histogram",
+    "snapshot", "prometheus_text", "reset_metrics", "flight_recorder",
+    "dump_flight_recorder", "export_chrome_trace", "as_session",
+    "merge_streams",
+]
+
+
+# ---------------------------------------------------------------------------
+# Records and the process-global bus
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    """One bus record: `t` monotonic seconds (in-process ordering), `wall`
+    epoch seconds (cross-process merging), `process` the controller rank,
+    `kind` the event name (the union of every tier's kinds —
+    docs/observability.md), `step` the step count it is anchored to (None
+    for step-less events), `payload` the kind-specific detail."""
+    t: float
+    wall: float
+    process: int
+    kind: str
+    step: Optional[int] = None
+    payload: dict = dataclasses.field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"t": self.t, "wall": self.wall, "process": self.process,
+                "kind": self.kind, "step": self.step,
+                "payload": self.payload}
+
+
+_lock = threading.RLock()
+_RING: Optional[deque] = None        # created lazily (size is an env knob)
+_SESSIONS: List["Telemetry"] = []    # attached sinks
+_process_cached: Optional[int] = None
+
+
+def _env():
+    from . import _env as env_mod
+
+    return env_mod
+
+
+def _process() -> int:
+    """Controller rank for stamping records.  Lazy and failure-tolerant:
+    telemetry must never be the thing that initializes a JAX backend (or
+    crashes because none exists yet), so before the backend is up records
+    are stamped rank 0 and the real rank is cached on first success."""
+    global _process_cached
+    if _process_cached is not None:
+        return _process_cached
+    try:
+        import jax
+
+        _process_cached = int(jax.process_index())
+    except Exception:
+        return 0
+    return _process_cached
+
+
+def _ring() -> deque:
+    global _RING
+    if _RING is None:
+        with _lock:
+            if _RING is None:
+                size = max(1, int(_env().integer(
+                    "IGG_TELEMETRY_FLIGHT_RECORDER", 512)))
+                _RING = deque(maxlen=size)
+    return _RING
+
+
+def emit(kind: str, step: Optional[int] = None, **payload) -> Record:
+    """Stamp and publish one record: append it to the flight-recorder ring
+    (always — a deque append) and hand it to every attached session sink.
+    Pure host bookkeeping: no device work, no synchronization."""
+    rec = Record(t=time.monotonic(), wall=time.time(), process=_process(),
+                 kind=kind, step=None if step is None else int(step),
+                 payload=payload)
+    _ring().append(rec)
+    if _SESSIONS:
+        with _lock:
+            sessions = list(_SESSIONS)
+        for s in sessions:
+            s._write_record(rec)
+    return rec
+
+
+def flight_recorder() -> List[Record]:
+    """The flight-recorder ring's current contents, oldest first."""
+    return list(_ring())
+
+
+def dump_flight_recorder(reason: str = "requested",
+                         path=None) -> List[pathlib.Path]:
+    """Dump the ring as JSON: to every attached session's
+    `flight_r<rank>.json`, to `path` when given, and — with neither — to
+    `IGG_TELEMETRY_DIR` when set.  Returns the paths written (empty when
+    there is nowhere to write — the ring itself always remains readable
+    via :func:`flight_recorder`)."""
+    recs = [r.as_dict() for r in _ring()]
+    doc = {"reason": reason, "wall": time.time(),
+           "process": _process(), "events": recs}
+    out: List[pathlib.Path] = []
+    targets: List[pathlib.Path] = []
+    if path is not None:
+        targets.append(pathlib.Path(path))
+    with _lock:
+        sessions = list(_SESSIONS)
+    for s in sessions:
+        targets.append(s.dir / f"flight_r{_process()}.json")
+    if not targets:
+        envdir = _env().text("IGG_TELEMETRY_DIR")
+        if envdir:
+            targets.append(pathlib.Path(envdir)
+                           / f"flight_r{_process()}.json")
+    for t in targets:
+        try:
+            t.parent.mkdir(parents=True, exist_ok=True)
+            tmp = t.with_name(t.name + ".tmp")
+            tmp.write_text(json.dumps(doc, default=str))
+            os.replace(tmp, t)
+            out.append(t)
+        except OSError:
+            continue   # a full/readonly disk must not mask the real fault
+    return out
+
+
+def _auto_dump(reason: str) -> None:
+    """The run loops' fault hook: dump the flight recorder wherever a sink
+    is configured (attached session or IGG_TELEMETRY_DIR); silently a no-op
+    when telemetry is entirely unconfigured."""
+    with _lock:
+        have_session = bool(_SESSIONS)
+    if have_session or _env().text("IGG_TELEMETRY_DIR"):
+        dump_flight_recorder(reason)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+_METRICS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "_Metric"] = {}
+
+
+class _Metric:
+    kind = "untyped"
+
+    __slots__ = ("name", "labels", "_lock")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    def key(self) -> str:
+        if not self.labels:
+            return self.name
+        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
+        return f"{self.name}{{{inner}}}"
+
+    def as_dict(self) -> dict:   # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotone counter (`.inc(v)`); `.value` reads it."""
+    kind = "counter"
+    __slots__ = ("value",)
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise GridError(f"Counter {self.name}: negative increment {v}.")
+        with self._lock:
+            self.value += v
+
+    def as_dict(self) -> dict:
+        return {"type": self.kind, "value": self.value}
+
+
+class Gauge(_Metric):
+    """Last-write-wins instantaneous value (`.set(v)`)."""
+    kind = "gauge"
+    __slots__ = ("value",)
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def as_dict(self) -> dict:
+        return {"type": self.kind, "value": self.value}
+
+
+class Histogram(_Metric):
+    """Streaming summary (`.observe(v)`): count, sum, min, max — enough
+    for latency/size distributions without bucket configuration."""
+    kind = "histogram"
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def as_dict(self) -> dict:
+        return {"type": self.kind, "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+
+def _get_metric(cls, name: str, labels: dict) -> _Metric:
+    lab = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    key = (name, lab)
+    m = _METRICS.get(key)
+    if m is None:
+        with _lock:
+            m = _METRICS.get(key)
+            if m is None:
+                m = _METRICS[key] = cls(name, lab)
+    if not isinstance(m, cls):
+        raise GridError(f"metric {name!r} is a {m.kind}, not a "
+                        f"{cls.kind} — one name, one type.")
+    return m
+
+
+def counter(name: str, **labels) -> Counter:
+    """Get-or-create the named counter (optional labels)."""
+    return _get_metric(Counter, name, labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _get_metric(Gauge, name, labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return _get_metric(Histogram, name, labels)
+
+
+def snapshot() -> Dict[str, dict]:
+    """The whole registry as `{exposition-key: {type, value|count/sum/
+    min/max}}` — a plain JSON-serializable dict."""
+    with _lock:
+        metrics = list(_METRICS.values())
+    return {m.key(): m.as_dict() for m in metrics}
+
+
+def reset_metrics() -> None:
+    """Clear the registry (``igg.finalize_global_grid`` leaves metrics
+    alone — they are process-scoped, like the flight recorder; tests call
+    this for isolation)."""
+    with _lock:
+        _METRICS.clear()
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def prometheus_text() -> str:
+    """The registry in the Prometheus text exposition format (histograms
+    render as summaries: `_count`/`_sum`, plus `_min`/`_max` gauges)."""
+    with _lock:
+        metrics = list(_METRICS.values())
+    by_name: Dict[str, List[_Metric]] = {}
+    for m in metrics:
+        by_name.setdefault(m.name, []).append(m)
+    out = io.StringIO()
+    for name in sorted(by_name):
+        group = by_name[name]
+        pname = _prom_name(name)
+        kind = group[0].kind
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "summary"}[kind]
+        out.write(f"# TYPE {pname} {ptype}\n")
+        for m in sorted(group, key=lambda g: g.labels):
+            lab = ("{" + ",".join(f'{_prom_name(k)}="{v}"'
+                                  for k, v in m.labels) + "}"
+                   if m.labels else "")
+            if kind == "histogram":
+                out.write(f"{pname}_count{lab} {m.count}\n")
+                out.write(f"{pname}_sum{lab} {m.sum}\n")
+                if m.count:
+                    out.write(f"{pname}_min{lab} {m.min}\n")
+                    out.write(f"{pname}_max{lab} {m.max}\n")
+            else:
+                out.write(f"{pname}{lab} {m.value}\n")
+    return out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Trace spans
+# ---------------------------------------------------------------------------
+
+_device_annotation_ok = True   # flipped off permanently on first failure
+
+
+def _device_annotation(name: str):
+    """A `jax.profiler.TraceAnnotation` for mirroring a host span onto the
+    device timeline — None when disabled (`IGG_TELEMETRY_DEVICE=0`) or
+    unavailable (disabled permanently on first failure, so a broken
+    profiler backend costs one try, not one per span)."""
+    global _device_annotation_ok
+    if not _device_annotation_ok:
+        return None
+    try:
+        if not _env().flag("IGG_TELEMETRY_DEVICE", True):
+            return None   # knob is off NOW — it may be turned back on
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except GridError:
+        raise
+    except Exception:
+        _device_annotation_ok = False
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, step: Optional[int] = None, **attrs):
+    """Record the enclosed block as a named trace span: one `span` record
+    on the bus (payload: name, dur_s, start timestamps, thread id, attrs)
+    and a mirrored `jax.profiler.TraceAnnotation` on the device timeline.
+    `IGG_TELEMETRY_SPANS=0` turns capture off (the block still runs)."""
+    if not _env().flag("IGG_TELEMETRY_SPANS", True):
+        yield
+        return
+    dev = _device_annotation(name)
+    t0 = time.monotonic()
+    w0 = time.time()
+    if dev is not None:
+        dev.__enter__()
+    try:
+        yield
+    finally:
+        if dev is not None:
+            dev.__exit__(None, None, None)
+        dur = time.monotonic() - t0
+        emit("span", step=step, name=name, dur_s=dur, t0=t0, wall0=w0,
+             tid=threading.get_ident(), **attrs)
+
+
+def _chrome_events(records: Sequence[Record]) -> List[dict]:
+    out = []
+    for r in records:
+        if r.kind != "span":
+            continue
+        p = r.payload
+        out.append({
+            "name": p.get("name", "span"), "cat": "igg", "ph": "X",
+            "ts": p.get("wall0", r.wall) * 1e6,
+            "dur": max(p.get("dur_s", 0.0), 0.0) * 1e6,
+            "pid": r.process, "tid": p.get("tid", 0),
+            "args": {k: v for k, v in p.items()
+                     if k not in ("name", "dur_s", "t0", "wall0", "tid")},
+        })
+    return out
+
+
+def export_chrome_trace(path, records: Optional[Sequence[Record]] = None
+                        ) -> pathlib.Path:
+    """Write the span records (default: the flight-recorder ring's) as a
+    Chrome-trace/Perfetto JSON object (`{"traceEvents": [...]}` — opens in
+    ui.perfetto.dev or chrome://tracing).  Timestamps are wall-clock
+    microseconds, so traces from several processes overlay correctly."""
+    recs = list(records) if records is not None else flight_recorder()
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    doc = {"traceEvents": _chrome_events(recs),
+           "displayTimeUnit": "ms",
+           "metadata": {"producer": "igg.telemetry",
+                        "process": _process()}}
+    path.write_text(json.dumps(doc, default=str))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Sessions: per-run JSONL sinks + exports
+# ---------------------------------------------------------------------------
+
+class Telemetry:
+    """One observability session rooted at a directory.  While attached
+    (context manager, or the run loops' `telemetry=` knob) every bus
+    record is appended to `events_r<rank>.jsonl`; metrics snapshots are
+    exported periodically (`metrics_every` seconds — default
+    `IGG_TELEMETRY_METRICS_EVERY`, 0 = at detach only) to
+    `metrics_r<rank>.jsonl` + `metrics_r<rank>.prom`, the span trace is
+    written to `trace_r<rank>.json` at detach, and the flight recorder is
+    dumped to `flight_r<rank>.json` on faults.  Sessions nest/stack: the
+    bus fans every record out to all attached sessions.
+
+    Multihost: attach AFTER the JAX backend is up (the run loops do —
+    they attach inside an initialized grid).  Rank tags come from
+    `jax.process_index()`; a session attached before backend init on a
+    SHARED directory would stamp every host's events file rank 0."""
+
+    def __init__(self, dir, *, metrics_every: Optional[float] = None):
+        self.dir = pathlib.Path(dir)
+        self.metrics_every = (float(metrics_every)
+                              if metrics_every is not None
+                              else _env().number(
+                                  "IGG_TELEMETRY_METRICS_EVERY", 0.0))
+        self.attached = False
+        self._events_fh = None
+        # Bounded like the flight ring: the trace export keeps the LAST
+        # N spans (a days-long run's full span history lives in the
+        # events JSONL; the trace file is the recent-window view).
+        self._spans: deque = deque(maxlen=4096)
+        self._last_metrics = 0.0
+        self._io_lock = threading.Lock()
+
+    # -- file naming (rank-tagged for the multihost merge tool) ------------
+    @property
+    def events_path(self) -> pathlib.Path:
+        return self.dir / f"events_r{_process()}.jsonl"
+
+    @property
+    def metrics_path(self) -> pathlib.Path:
+        return self.dir / f"metrics_r{_process()}.jsonl"
+
+    @property
+    def prometheus_path(self) -> pathlib.Path:
+        return self.dir / f"metrics_r{_process()}.prom"
+
+    @property
+    def trace_path(self) -> pathlib.Path:
+        return self.dir / f"trace_r{_process()}.json"
+
+    @property
+    def flight_path(self) -> pathlib.Path:
+        return self.dir / f"flight_r{_process()}.json"
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach(self) -> "Telemetry":
+        """Start sinking bus records into this session's directory
+        (idempotent)."""
+        with _lock:
+            if self.attached:
+                return self
+            self.dir.mkdir(parents=True, exist_ok=True)
+            self._events_fh = open(self.events_path, "a", buffering=1)
+            self._last_metrics = time.monotonic()
+            _SESSIONS.append(self)
+            self.attached = True
+        return self
+
+    def detach(self) -> None:
+        """Final exports (metrics snapshot + Prometheus file + Chrome
+        trace) and stop sinking (idempotent)."""
+        with _lock:
+            if not self.attached:
+                return
+            self.attached = False
+            if self in _SESSIONS:
+                _SESSIONS.remove(self)
+        self.export_metrics()
+        try:
+            export_chrome_trace(self.trace_path, self._spans)
+        except OSError:
+            pass
+        with self._io_lock:
+            if self._events_fh is not None:
+                self._events_fh.close()
+                self._events_fh = None
+
+    def __enter__(self) -> "Telemetry":
+        return self.attach()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            # Still attached, so this session's flight_path is already an
+            # auto-target — no explicit path (it would be written twice).
+            dump_flight_recorder(f"{exc_type.__name__}: {exc}")
+        self.detach()
+
+    # -- sinks -------------------------------------------------------------
+    def _write_record(self, rec: Record) -> None:
+        if rec.kind == "span":
+            with self._io_lock:
+                self._spans.append(rec)
+        try:
+            line = json.dumps(rec.as_dict(), default=str)
+        except (TypeError, ValueError):
+            line = json.dumps({**rec.as_dict(), "payload": str(rec.payload)})
+        try:
+            with self._io_lock:
+                if self._events_fh is not None:
+                    self._events_fh.write(line + "\n")
+        except OSError:
+            pass   # a full/readonly sink must never kill the monitored run
+
+    def maybe_export_metrics(self) -> bool:
+        """Periodic-export check (the run loops call this at the watch
+        cadence): exports when `metrics_every` seconds have elapsed since
+        the last export.  Cheap when not due — one clock read."""
+        if not self.metrics_every:
+            return False
+        now = time.monotonic()
+        if now - self._last_metrics < self.metrics_every:
+            return False
+        self.export_metrics()
+        return True
+
+    def export_metrics(self) -> None:
+        """Write one metrics snapshot line (JSONL) and rewrite the
+        Prometheus exposition file."""
+        self._last_metrics = time.monotonic()
+        snap = {"t": time.monotonic(), "wall": time.time(),
+                "process": _process(), "metrics": snapshot()}
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            with open(self.metrics_path, "a") as fh:
+                fh.write(json.dumps(snap, default=str) + "\n")
+            tmp = self.prometheus_path.with_name(
+                self.prometheus_path.name + ".tmp")
+            tmp.write_text(prometheus_text())
+            os.replace(tmp, self.prometheus_path)
+        except OSError:
+            pass   # telemetry export must never kill the run
+
+
+def as_session(telemetry) -> Optional[Telemetry]:
+    """Coerce the run loops' `telemetry=` knob: None → a session under
+    `IGG_TELEMETRY_DIR` when that is set (else no session); True → the env
+    directory (GridError when unset); a str/Path → a session at that
+    directory; a :class:`Telemetry` → itself; False → off even when the
+    env knob is set."""
+    if telemetry is False:
+        return None
+    if isinstance(telemetry, Telemetry):
+        return telemetry
+    if telemetry is None or telemetry is True:
+        envdir = _env().text("IGG_TELEMETRY_DIR")
+        if envdir:
+            return Telemetry(envdir)
+        if telemetry is True:
+            raise GridError(
+                "telemetry=True needs a directory: set IGG_TELEMETRY_DIR "
+                "or pass telemetry=<dir> / a Telemetry session.")
+        return None
+    if isinstance(telemetry, (str, os.PathLike)):
+        return Telemetry(telemetry)
+    raise GridError(
+        f"telemetry={telemetry!r}: expected None, False, True, a "
+        f"directory path, or an igg.telemetry.Telemetry session.")
+
+
+# ---------------------------------------------------------------------------
+# The step-stats meter (piggybacks on the watchdog's async fetch channel)
+# ---------------------------------------------------------------------------
+
+class StepStats:
+    """Per-window step-rate telemetry with ZERO additional host syncs.
+
+    The resilient/ensemble watchdogs already fetch their probes
+    asynchronously (`is_ready()` polling); this meter timestamps each
+    healthy fetch on the host and derives the rate from consecutive
+    fetches — the device is never asked anything the watchdog did not
+    already ask.  A drain that fetches several queued probes back-to-back
+    yields near-zero deltas; those windows are skipped (`_MIN_DT`), not
+    extrapolated into nonsense rates."""
+
+    _MIN_DT = 1e-4
+
+    def __init__(self, run: str, members: Optional[int] = None):
+        self.run = run
+        self.members = members
+        self._anchor: Optional[Tuple[int, float]] = None
+        self._sps = gauge("igg_steps_per_s", run=run)
+        self._lag = gauge("igg_watchdog_fetch_lag_steps", run=run)
+        self._msps = (gauge("igg_member_steps_per_s") if members else None)
+
+    def fetched(self, probe_step: int, current_step: int,
+                active_members: Optional[int] = None) -> None:
+        """One healthy probe was fetched (host-side, post-`is_ready`)."""
+        now = time.monotonic()
+        lag = max(0, current_step - probe_step)
+        self._lag.set(lag)
+        anchor = self._anchor
+        self._anchor = (probe_step, now)
+        if anchor is None:
+            return
+        dsteps = probe_step - anchor[0]
+        dt = now - anchor[1]
+        if dsteps <= 0 or dt < self._MIN_DT:
+            return
+        sps = dsteps / dt
+        self._sps.set(sps)
+        payload = {"run": self.run, "steps_per_s": sps,
+                   "ms_per_step": 1e3 / sps, "window_steps": dsteps,
+                   "fetch_lag_steps": lag}
+        if active_members is not None:
+            msps = sps * active_members
+            payload["members_active"] = active_members
+            payload["member_steps_per_s"] = msps
+            if self._msps is not None:
+                self._msps.set(msps)
+        emit("step_stats", step=probe_step, **payload)
+
+
+# ---------------------------------------------------------------------------
+# Multihost merge tool
+# ---------------------------------------------------------------------------
+
+def merge_streams(inputs: Sequence, output=None) -> List[dict]:
+    """Merge rank-tagged event JSONL files into one stream ordered by wall
+    time (ties broken by process then monotonic t).  `inputs` are files or
+    directories (directories contribute their `events_r*.jsonl`);
+    `output` is a path ('-' or None returns the records without
+    writing).  Unparsable lines are skipped with a count in the trailing
+    summary record rather than aborting the merge — a post-mortem must
+    survive a half-written line from a killed process."""
+    files: List[pathlib.Path] = []
+    for item in inputs:
+        p = pathlib.Path(item)
+        if p.is_dir():
+            files.extend(sorted(p.glob("events_r*.jsonl")))
+        else:
+            files.append(p)
+    if not files:
+        raise GridError(f"telemetry merge: no event files found in "
+                        f"{[str(i) for i in inputs]}.")
+    records: List[dict] = []
+    skipped = 0
+    for f in files:
+        try:
+            text = f.read_text()
+        except OSError as e:
+            raise GridError(f"telemetry merge: cannot read {f}: {e}")
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                skipped += 1
+    records.sort(key=lambda r: (r.get("wall", 0.0), r.get("process", 0),
+                                r.get("t", 0.0)))
+    if skipped:
+        records.append({"kind": "merge_summary", "process": -1,
+                        "wall": time.time(),
+                        "payload": {"skipped_lines": skipped,
+                                    "files": [str(f) for f in files]}})
+    if output is not None and str(output) != "-":
+        out = pathlib.Path(output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        with open(out, "w") as fh:
+            for r in records:
+                fh.write(json.dumps(r, default=str) + "\n")
+    return records
+
+
+def _main(argv: Sequence[str]) -> int:
+    import sys
+
+    usage = ("usage: python -m igg.telemetry merge <out.jsonl|-> "
+             "<events.jsonl|session-dir> [...]")
+    if len(argv) < 1 or argv[0] != "merge":
+        print(usage, file=sys.stderr)
+        return 2
+    if len(argv) < 3:
+        print(usage, file=sys.stderr)
+        return 2
+    out, inputs = argv[1], argv[2:]
+    records = merge_streams(inputs, out)
+    if out == "-":
+        for r in records:
+            print(json.dumps(r, default=str))
+    else:
+        print(f"merged {len(records)} records from {len(inputs)} input(s) "
+              f"-> {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":   # python -m igg.telemetry merge ...
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

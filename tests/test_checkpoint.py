@@ -435,10 +435,12 @@ class TestSharded:
         t.start()
         time.sleep(0.2)       # let the peer observe the stale staging dir
         # Process 0 of the relaunch: clear the dead attempt, restage, and
-        # answer hellos from the shard-wait poll loop.
-        import shutil
+        # answer hellos from the shard-wait poll loop.  The clear uses the
+        # production helper: the live peer's re-hello can land DURING the
+        # rmtree (a real race this test used to lose on loaded hosts).
+        from igg.checkpoint import _rmtree_contended
 
-        shutil.rmtree(staging)
+        _rmtree_contended(staging)
         staging.mkdir()
         deadline = time.monotonic() + 10.0
         while t.is_alive() and time.monotonic() < deadline:
