@@ -48,8 +48,13 @@ def _commit() -> "str | None":
 def _toolchain() -> dict:
     """The environment half of the provenance header: jax/jaxlib versions,
     backend, device kind, process count.  Cached — the backend is queried
-    once per benchmark process."""
+    once per benchmark process.  backend/device_kind come from
+    `igg.perf.device_context` — the SAME source the perf-ledger keys and
+    the `igg.perf compare` provenance matching use, so bench rows and
+    ledger entries stay joinable by construction."""
     import jax
+
+    from igg.perf import device_context
 
     try:
         import jaxlib
@@ -57,12 +62,10 @@ def _toolchain() -> dict:
         jaxlib_version = getattr(jaxlib, "__version__", None)
     except ImportError:   # jaxlib folded into jax on some builds
         jaxlib_version = None
-    dev = jax.devices()[0]
     return {
         "jax": jax.__version__,
         "jaxlib": jaxlib_version,
-        "backend": jax.default_backend(),
-        "device_kind": getattr(dev, "device_kind", dev.platform),
+        **device_context(),
         "processes": int(jax.process_count()),
     }
 

@@ -74,6 +74,17 @@ def _measure_family(name, n, nt):
                           n_inner=n_inner, overlap=True, use_pallas=False)
     else:
         raise ValueError(name)
+    # Perf ledger (igg.perf): the measured single-chip step time is
+    # exactly the calibration sample the future autotuner wants as its
+    # prior — record it against the tier that actually served the run
+    # (use_pallas=False pins the XLA composition truth).
+    from igg import degrade, perf
+
+    tier = degrade.active().get(name)
+    if tier is not None:
+        perf.record(name, tier, sec * 1e3, source="calibrate",
+                    local_shape=(n, n, n), dtype="float32",
+                    dims=(1, 1, 1), **perf.device_context())
     igg.finalize_global_grid()
     return sec
 
@@ -147,6 +158,15 @@ def main():
             predicted = stats["total_fusion_cycles"] / clock
             meas = measured[fam]
             rel = (predicted - meas) / meas
+            # Live drift gauges (igg.perf): register the prediction so
+            # the igg_cost_model_rel_error gauge tracks it against every
+            # subsequent measured sample of the family (and a
+            # cost_model_drift bus event fires past IGG_PERF_DRIFT_TOL);
+            # the ledger sample recorded in _measure_family pairs with
+            # it immediately.
+            from igg import perf
+
+            perf.predict(fam, predicted, topology=label)
             # jax's .platform is only ever 'tpu'/'cpu'/'gpu'; the chip
             # generation lives in device_kind (e.g. 'TPU v5e').
             kind = getattr(jax.devices()[0], "device_kind", "").lower()

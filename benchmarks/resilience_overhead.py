@@ -156,6 +156,16 @@ def main():
     b, w = min(bares), min(watcheds)
     bare_s_per_step = b / nt
 
+    # Perf ledger (igg.perf): the measured bare step time IS a
+    # calibration-grade sample for the tier that served the bare loop —
+    # bench rows and the autotuner prior stay one store.
+    from igg import perf as iperf
+
+    iperf.record("diffusion3d",
+                 igg.degrade.active().get("diffusion3d", "diffusion3d.xla"),
+                 bare_s_per_step * 1e3, source="bench",
+                 **iperf.sample_context(T0))
+
     overhead_pct = probe_s / (watch_every * bare_s_per_step) * 100.0
     wall_delta_pct = (w - b) / b * 100.0
 

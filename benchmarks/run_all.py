@@ -12,7 +12,11 @@ BENCH_r* rows are attributable to the exact environment that produced
 them (backfill-tolerant reading: benchmarks/README.md, "Reading the
 provenance header").
 
-Usage: `python benchmarks/run_all.py [--quick]`.
+Usage: `python benchmarks/run_all.py [--quick] [--compare [--tol=X]]
+[--update-goldens]` — `--compare` regression-gates the fresh artifacts
+against the committed CPU-smoke goldens (`benchmarks/goldens/`, via
+`python -m igg.perf compare`); `--update-goldens` refreshes them
+(benchmarks/README.md, "The golden-baseline workflow").
 """
 
 from __future__ import annotations
@@ -24,6 +28,15 @@ import sys
 
 HERE = pathlib.Path(__file__).resolve().parent
 RESULTS = HERE / "results"
+GOLDENS = HERE / "goldens"
+# The committed CPU-smoke golden baselines (regression-gated by
+# `--compare` / ci.sh via `python -m igg.perf compare`): the
+# contract-bearing artifacts whose rows are deterministic on the smoke
+# mesh — presence + "pass" flags gate strictly; values only within the
+# (generous, CPU-noise-sized) tolerance.  TPU evidence is never gated
+# against these: compare skips rows whose provenance
+# (backend, device_kind, smoke) does not match.
+GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput")
 
 
 def run(script: str, args, *, virtual: int = 0, tag: str,
@@ -40,11 +53,24 @@ def run(script: str, args, *, virtual: int = 0, tag: str,
     out = subprocess.run(cmd, env=env, capture_output=True, text=True,
                          cwd=str(HERE.parent))
     sys.stderr.write(out.stderr)
+    results = RESULTS if results is None else results
+    # parents=True: a caller-supplied results path whose parent does not
+    # exist yet must not crash the runner at the first artifact.
+    results.mkdir(parents=True, exist_ok=True)
     if out.returncode != 0:
+        if out.stdout.strip():
+            # The rows emitted before the crash are the postmortem: a
+            # failed benchmark's partial stdout used to be discarded
+            # (only stderr was echoed).  Saved under a .failed.jsonl
+            # name so no committed artifact or compare gate reads it as
+            # a complete run.
+            failed = results / f"{tag}.failed.jsonl"
+            failed.write_text(out.stdout)
+            print(f"!!! {tag}: partial stdout "
+                  f"({len(out.stdout.splitlines())} line(s)) saved to "
+                  f"{failed}", file=sys.stderr)
         print(f"!!! {tag} failed (exit {out.returncode})", file=sys.stderr)
         sys.exit(1)
-    results = RESULTS if results is None else results
-    results.mkdir(exist_ok=True)
     if out.stdout.strip():
         (results / f"{tag}.jsonl").write_text(out.stdout)
     else:
@@ -125,6 +151,53 @@ def main():
     r("cpu_example.py", [] if not quick else [64], tag="cpu_example")
     r("pod_run.py", ["--local", 16, "--nt", 2, "--n-inner", 3], virtual=8,
       tag="pod_run_mesh8")
+
+    outdir = res if res is not None else RESULTS
+    if "--update-goldens" in sys.argv:
+        update_goldens(outdir)
+    if "--compare" in sys.argv:
+        tol = 3.0
+        for a in sys.argv:
+            if a.startswith("--tol="):
+                tol = float(a.split("=", 1)[1])
+        compare_goldens(outdir, tol=tol)
+
+
+def update_goldens(results: pathlib.Path) -> None:
+    """Refresh the committed golden baselines from a finished run's
+    artifacts (the documented workflow: `python benchmarks/run_all.py
+    --quick --update-goldens` on the CI-shaped host, then commit
+    `benchmarks/goldens/`)."""
+    GOLDENS.mkdir(parents=True, exist_ok=True)
+    for tag in GOLDEN_TAGS:
+        src = results / f"{tag}.jsonl"
+        if not src.exists():
+            print(f"!!! update-goldens: {src} missing (run the benchmarks "
+                  f"first)", file=sys.stderr)
+            sys.exit(1)
+        (GOLDENS / f"{tag}.jsonl").write_text(src.read_text())
+        print(f"=== golden refreshed: goldens/{tag}.jsonl",
+              file=sys.stderr)
+
+
+def compare_goldens(results: pathlib.Path, *, tol: float) -> None:
+    """Regression-gate this run's artifacts against the committed
+    goldens via `python -m igg.perf compare` (a subprocess, like the
+    benchmarks themselves — this parent must never initialize a JAX
+    backend).  Exits nonzero on regressions, which fails CI."""
+    if not GOLDENS.is_dir():
+        print("!!! --compare: no benchmarks/goldens/ directory "
+              "(run --update-goldens once and commit it)", file=sys.stderr)
+        sys.exit(1)
+    cmd = [sys.executable, "-m", "igg.perf", "compare", str(GOLDENS),
+           str(results), "--tol", str(tol)]
+    print(f"=== regression gate: {' '.join(cmd[1:])}", file=sys.stderr)
+    rc = subprocess.run(cmd, cwd=str(HERE.parent)).returncode
+    if rc != 0:
+        print(f"!!! regression gate failed (exit {rc})", file=sys.stderr)
+        sys.exit(rc)
+    print("=== regression gate PASS (golden baselines hold)",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py
 
 echo "=== benchmark harness smoke (--quick, CPU mesh; artifacts stamped"
-echo "    smoke=true) ==="
-python benchmarks/run_all.py --quick
+echo "    smoke=true) + golden-baseline regression gate (round 13:"
+echo "    python -m igg.perf compare vs benchmarks/goldens/ — presence +"
+echo "    'pass' contract flags gate strictly, values within the"
+echo "    CPU-noise tolerance) ==="
+python benchmarks/run_all.py --quick --compare
 
 # The smoke artifacts must carry one open-boundary chunk row (round 6 —
 # the reference-default boundary condition on the K-step tier runs its
@@ -170,6 +173,45 @@ echo "    snapshot + Prometheus file + span trace; ResilienceError ->"
 echo "    flight-recorder auto-dump; python -m igg.telemetry merge) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/observed_run.py
+
+# Round 13: performance observability end to end.  A model-backed run on
+# the 8-device mesh fills the perf ledger (watchdog windows attributed
+# to the serving tier via igg.degrade.active(), a verify-first-use
+# sample, an explicit igg.perf.calibrate), the ledger persists as
+# versioned JSON, round-trips through the `python -m igg.perf
+# show|merge` CLI, and igg.perf.best() answers for the served
+# (family, tier, shape) — all asserted inside the example.  The PR-7
+# zero-host-syncs sentinel ran with the ledger enabled in the pytest
+# suite above.
+echo "=== perf observability end to end (run -> ledger -> show/merge"
+echo "    round-trip -> igg.perf.best; 8-device CPU mesh) ==="
+IGG_PERF_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    IGG_PERF_LEDGER="$IGG_PERF_TMP/ledger.json" python examples/perf_run.py
+rm -rf "$IGG_PERF_TMP"
+
+# Round 13: prove the regression gate actually gates — a synthetic row
+# 20% slower than its baseline twin must flip `igg.perf compare` to a
+# nonzero exit at --tol 0.1 (the goldens comparison above proves the
+# green path; this proves the red one).
+echo "=== regression-gate proof (injected 20% slowdown row must fail"
+echo "    igg.perf compare at --tol 0.1) ==="
+IGG_GATE_TMP=$(mktemp -d)
+cat > "$IGG_GATE_TMP/base.jsonl" <<'EOF'
+{"metric": "gate_proof_ms", "value": 100.0, "unit": "ms", "smoke": true, "provenance": {"backend": "cpu", "device_kind": "cpu"}, "config": {"n": 64}}
+EOF
+cat > "$IGG_GATE_TMP/new.jsonl" <<'EOF'
+{"metric": "gate_proof_ms", "value": 120.0, "unit": "ms", "smoke": true, "provenance": {"backend": "cpu", "device_kind": "cpu"}, "config": {"n": 64}}
+EOF
+if python -m igg.perf compare "$IGG_GATE_TMP/base.jsonl" \
+        "$IGG_GATE_TMP/new.jsonl" --tol 0.1; then
+    echo "    regression gate FAILED to flag the injected 20% slowdown"
+    rm -rf "$IGG_GATE_TMP"
+    exit 1
+else
+    echo "    regression gate correctly rejected the injected slowdown"
+fi
+rm -rf "$IGG_GATE_TMP"
 
 # Compiled-mode TPU kernel tests (VERDICT r3 weak item 4): run
 # unconditionally — the tests' own per-test gate (the single source of

@@ -1,0 +1,151 @@
+"""Performance observability end to end: a real run on the 8-device CPU
+mesh produces a persistent perf ledger whose entries answer
+`igg.perf.best(...)` for the served (family, tier, shape), round-trip
+through the `python -m igg.perf show|merge` CLI, and carry the roofline/
+drift bookkeeping — the `ci.sh` acceptance proof for `igg.perf`.
+
+1. `run_resilient` drives the diffusion3d model (interpret-mode Mosaic
+   tier, `verify="first_use"`): the watchdog's step-stats windows land in
+   the ledger attributed to the SERVING tier (`igg.degrade.active()`),
+   and the one-time verification contributes its warm timed dispatch —
+   all with zero additional device→host syncs (the sentinel test in
+   `tests/test_telemetry.py` asserts that; this script asserts the
+   attribution and the query API).
+2. `igg.perf.calibrate("diffusion3d")` is the explicit AOT path: it
+   slope-times the family's default step and records the sample.
+3. The ledger persists (`IGG_PERF_LEDGER`, versioned
+   igg-perf-ledger-v1), `python -m igg.perf show` renders it, and
+   `python -m igg.perf merge` combines two copies (aggregate counts
+   add, best_ms stays the min) — the multi-process/multi-run story.
+
+Run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        IGG_PERF_LEDGER=/tmp/igg_perf/ledger.json python examples/perf_run.py
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+# A ledger path must exist before igg reads the knob; default to a
+# scratch directory so the example is self-contained.
+_owned_tmp = None
+if not os.environ.get("IGG_PERF_LEDGER"):
+    _owned_tmp = tempfile.mkdtemp(prefix="igg_perf_run_")
+    os.environ["IGG_PERF_LEDGER"] = os.path.join(_owned_tmp, "ledger.json")
+
+import igg
+from igg import perf
+from igg.models import diffusion3d as d3
+
+
+def main():
+    ledger = pathlib.Path(os.environ["IGG_PERF_LEDGER"])
+    print(f"== perf_run: ledger at {ledger}")
+
+    igg.init_global_grid(8, 8, 128, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    igg.degrade.reset()
+    perf.reset()
+
+    # -- 1. the observed run: watchdog windows + verify-first-use -------
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step = d3.make_step(params, donate=False, pallas_interpret=True,
+                            verify="first_use")
+        res = igg.run_resilient(lambda s: {"T": step(s["T"], Cp)},
+                                {"T": T0 + 0}, 40, watch_every=10,
+                                install_sigterm=False, telemetry=False)
+    assert res.steps_done == 40
+    serving = igg.degrade.active()["diffusion3d"]
+    print(f"== run done; serving tier: {serving}")
+
+    entries = perf.query("diffusion3d", tier=serving)
+    assert entries, "no ledger entry for the serving tier"
+    e = entries[0]
+    srcs = set(e["sources"])
+    assert "verify_first_use" in srcs, srcs
+    assert "watchdog" in srcs, (
+        f"watchdog windows did not land in the ledger (sources: {srcs})")
+    shape = tuple(e["local_shape"])
+    print(f"== serving-tier entry: shape={shape} dtype={e['dtype']} "
+          f"best={e['best_ms']:.3f} ms sources={e['sources']}")
+
+    # -- 2. the explicit AOT calibration path ---------------------------
+    sec = perf.calibrate("diffusion3d", nt=2, warmup=1)
+    print(f"== calibrate('diffusion3d'): {sec * 1e3:.3f} ms/dispatch "
+          f"(tier {igg.degrade.active()['diffusion3d']})")
+
+    # -- the query API the autotuner drives -----------------------------
+    bestE = perf.best("diffusion3d", local_shape=shape)
+    assert bestE is not None, "best() found nothing for the served shape"
+    others = perf.query("diffusion3d", local_shape=shape)
+    assert all(bestE["best_ms"] <= o["best_ms"] for o in others)
+    served_best = perf.best("diffusion3d", local_shape=shape, tier=serving)
+    assert served_best is not None and served_best["tier"] == serving
+    print(f"== perf.best('diffusion3d', {shape}) -> {bestE['tier']} "
+          f"@ {bestE['best_ms']:.3f} ms "
+          f"({len(others)} tier(s) recorded for the shape; served tier "
+          f"{serving} @ {served_best['best_ms']:.3f} ms)")
+
+    # -- 3. persistence + CLI round-trip --------------------------------
+    saved = perf.save()
+    assert saved == ledger and ledger.exists(), saved
+    doc = json.loads(ledger.read_text())
+    assert doc["format"] == "igg-perf-ledger-v1", doc.get("format")
+    n_entries = len(doc["entries"])
+    print(f"== saved {n_entries} entries")
+
+    env = dict(os.environ)
+    show = subprocess.run(
+        [sys.executable, "-m", "igg.perf", "show", str(ledger)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), os.pardir))
+    assert show.returncode == 0, show.stderr
+    assert "diffusion3d" in show.stdout and serving in show.stdout, \
+        show.stdout
+    print("== `python -m igg.perf show` renders the ledger")
+
+    merged = ledger.with_name("merged.json")
+    mrg = subprocess.run(
+        [sys.executable, "-m", "igg.perf", "merge", str(merged),
+         str(ledger), str(ledger)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), os.pardir))
+    assert mrg.returncode == 0, mrg.stderr
+    mdoc = json.loads(merged.read_text())
+    assert len(mdoc["entries"]) == n_entries            # same keys...
+    key = next(k for k, v in mdoc["entries"].items()
+               if v["tier"] == serving)
+    assert (mdoc["entries"][key]["count"]
+            == 2 * doc["entries"][key]["count"])        # ...counts added
+    assert (mdoc["entries"][key]["best_ms"]
+            == doc["entries"][key]["best_ms"])          # ...best is min
+    perf.reset()
+    perf.load(merged, replace=True)
+    again = perf.best("diffusion3d", local_shape=shape, tier=serving)
+    assert again is not None and again["tier"] == serving
+    print("== merge round-trip: counts added, best preserved, "
+          "best() answers from the merged ledger")
+
+    igg.finalize_global_grid()
+    print("== perf_run PASS")
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    finally:
+        if _owned_tmp:
+            shutil.rmtree(_owned_tmp, ignore_errors=True)

@@ -35,7 +35,11 @@ retry/backoff, a persistent journal, and elastic resume), and the unified
 observability subsystem (`igg.telemetry` — one timestamped, rank-tagged
 event bus with a flight-recorder ring, a metrics registry with
 Prometheus exposition, zero-sync device-side step stats, and Chrome-trace
-spans; docs/observability.md).
+spans; docs/observability.md), and the performance-observability layer
+(`igg.perf` — a persistent per-(family, tier, shape, dtype, topology)
+perf ledger feeding the future autotuner, live roofline and
+cost-model-drift gauges, and the `python -m igg.perf compare` benchmark
+regression gate).
 """
 
 from ._compat import install as _compat_install
@@ -102,6 +106,7 @@ from . import degrade
 from . import device
 from . import ensemble
 from . import fleet
+from . import perf
 from . import profiling
 from . import resilience
 from . import telemetry
@@ -130,6 +135,6 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
-    "telemetry", "Telemetry",
+    "telemetry", "Telemetry", "perf",
     "time_steps", "__version__",
 ]

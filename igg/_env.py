@@ -45,6 +45,15 @@ _KNOWN: Dict[str, str] = {
         "launcher-fault retries per fleet job before it is marked failed",
     "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
     "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
+    "IGG_PERF": "0 disables perf-ledger recording (igg.perf)",
+    "IGG_PERF_DRIFT_TOL":
+        "relative cost-model error beyond which a cost_model_drift bus "
+        "event fires (default 0.5)",
+    "IGG_PERF_LEDGER":
+        "path of the on-disk perf-ledger JSON (unset: in-memory only; "
+        "rank-tagged automatically on multi-controller runs)",
+    "IGG_PERF_SAVE_EVERY":
+        "minimum seconds between perf-ledger autosaves (default 60)",
     "IGG_TELEMETRY_DEVICE":
         "0 disables mirroring trace spans onto the device timeline "
         "(jax.profiler.TraceAnnotation)",

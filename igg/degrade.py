@@ -248,6 +248,17 @@ def active() -> Dict[str, str]:
     return dict(_ACTIVE)
 
 
+def active_records() -> List[Tuple[str, str, int]]:
+    """`[(family, serving tier, dispatch stamp)]` — :func:`active` plus
+    the monotone dispatch counter at each family's last dispatch, so a
+    consumer (the perf ledger's watchdog-window attribution,
+    `igg.perf.observe_window`) can tell which families dispatched inside
+    a given interval of :func:`dispatch_stamp` snapshots."""
+    with _lock:
+        return [(f, t, _ACTIVE_STAMP.get(f, 0))
+                for f, t in _ACTIVE.items()]
+
+
 def admission_log() -> Dict[str, str]:
     """The last structured refusal reason per tier (admission gates that
     returned False on the most recent dispatch walk)."""
@@ -542,6 +553,39 @@ class Ladder:
             raise _VerifyMismatch(detail)
         with _lock:
             _VERIFIED.add((t.name, sig))
+        self._perf_sample(t, fn, scratch)
+
+    def _perf_sample(self, t: Tier, fn: Callable, scratch) -> None:
+        """One WARM timed dispatch into the perf ledger after a tier
+        passes verification (the verify dispatch itself paid this
+        signature's compile, so its wall time is not a serving-cost
+        sample).  One extra dispatch per (tier, signature), inside the
+        one-time verify cost contract; ms is per DISPATCH (== per step
+        for the per-step factories).  Never allowed to fail a verified
+        dispatch — perf bookkeeping is advisory."""
+        from . import perf as _perf
+
+        if not _perf.enabled():
+            return
+        try:
+            import time as _time
+
+            import jax
+
+            args = scratch()
+            t0 = _time.monotonic()
+            out = self._call(t, fn, args)
+            jax.block_until_ready(out)
+            ms = (_time.monotonic() - t0) * 1e3
+            ctx = _perf.sample_context(args[0] if args else None)
+            _perf.record(self.family, t.name, ms,
+                         source="verify_first_use",
+                         local_shape=ctx.get("local_shape", ()),
+                         dtype=ctx.get("dtype", "-"),
+                         dims=ctx.get("dims"), backend=ctx.get("backend"),
+                         device_kind=ctx.get("device_kind"))
+        except Exception:   # pragma: no cover - advisory path
+            pass
 
     def _record_active(self, tier_name: str) -> None:
         global _DISPATCHES

@@ -621,7 +621,15 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     _telemetry.emit("run_started", run="ensemble", n_steps=n_steps,
                     members=members, packing=pk.name,
                     watch_every=watch_every, steps_per_call=steps_per_call)
-    stats = _telemetry.StepStats("ensemble", members=members)
+    # Perf-ledger context (igg.perf): the packed member-stacked block is
+    # the served shape — attribution mirrors run_resilient's (host-side
+    # ladder stamps on the existing fetch timestamps, zero extra syncs).
+    from . import perf as _perf
+
+    stats = _telemetry.StepStats(
+        "ensemble", members=members,
+        perf=(_perf.sample_context(state[watch[0]])
+              if watch and _perf.enabled() else None))
     m_steps = _telemetry.counter("igg_steps_total", run="ensemble")
     m_member_steps = _telemetry.counter("igg_member_steps_total")
     m_rollbacks = _telemetry.counter("igg_rollbacks_total", run="ensemble")
@@ -1043,11 +1051,12 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                         quarantined=sorted(int(m)
                                            for m in np.nonzero(~valid)[0]))
         if tel is not None:
-            try:
+            # Owned sessions export inside detach(); exporting here too
+            # would write two identical back-to-back snapshots.
+            if tel_owns:
+                tel.detach()
+            else:
                 tel.export_metrics()
-            finally:
-                if tel_owns:
-                    tel.detach()
 
     return EnsembleResult(
         state=state, members=members, steps_done=steps_done,

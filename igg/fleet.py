@@ -415,11 +415,12 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
         _telemetry.emit("run_finished", run="fleet",
                         preempted=fleet_preempted)
         if tel is not None:
-            try:
+            # Owned sessions export inside detach(); exporting here too
+            # would write two identical back-to-back snapshots.
+            if tel_owns:
+                tel.detach()
+            else:
                 tel.export_metrics()
-            finally:
-                if tel_owns:
-                    tel.detach()
 
     return FleetResult(jobs=outcomes, preempted=fleet_preempted,
                        journal=jpath)

@@ -504,7 +504,15 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         tel.attach()
     _telemetry.emit("run_started", run="resilient", n_steps=n_steps,
                     watch_every=watch_every, steps_per_call=steps_per_call)
-    stats = _telemetry.StepStats("resilient")
+    # Perf-ledger context (igg.perf): window rates are attributed to the
+    # serving kernel tier — ladder bookkeeping on the watchdog's existing
+    # fetch timestamps, zero additional host syncs.
+    from . import perf as _perf
+
+    stats = _telemetry.StepStats(
+        "resilient",
+        perf=(_perf.sample_context(state[watch[0]])
+              if watch and _perf.enabled() else None))
     m_steps = _telemetry.counter("igg_steps_total", run="resilient")
     m_rollbacks = _telemetry.counter("igg_rollbacks_total", run="resilient")
 
@@ -946,11 +954,14 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         _telemetry.emit("run_finished", step=steps_done, run="resilient",
                         preempted=preempted, retries=retries)
         if tel is not None:
-            try:
+            # Owned sessions get their final export inside detach();
+            # exporting here too would write two identical back-to-back
+            # snapshots.  Shared sessions stay attached, so the run-final
+            # snapshot is written explicitly.
+            if tel_owns:
+                tel.detach()
+            else:
                 tel.export_metrics()
-            finally:
-                if tel_owns:
-                    tel.detach()
 
     return RunResult(state=state, steps_done=steps_done, retries=retries,
                      preempted=preempted, events=events, checkpoint=last_ckpt)

@@ -171,7 +171,13 @@ def emit(kind: str, step: Optional[int] = None, **payload) -> Record:
     rec = Record(t=time.monotonic(), wall=time.time(), process=_process(),
                  kind=kind, step=None if step is None else int(step),
                  payload=payload)
-    _ring().append(rec)
+    # Appends and snapshots share the lock: the checkpoint layer emits
+    # from the async-writer THREAD, and an unsynchronized deque snapshot
+    # racing that append raises "deque mutated during iteration" — in
+    # exactly the fault path (_auto_dump) that must never mask the real
+    # error.  Uncontended acquire, still no I/O.
+    with _lock:
+        _ring().append(rec)
     if _SESSIONS:
         with _lock:
             sessions = list(_SESSIONS)
@@ -181,8 +187,11 @@ def emit(kind: str, step: Optional[int] = None, **payload) -> Record:
 
 
 def flight_recorder() -> List[Record]:
-    """The flight-recorder ring's current contents, oldest first."""
-    return list(_ring())
+    """The flight-recorder ring's current contents, oldest first (a
+    consistent snapshot — see the locking note in :func:`emit`)."""
+    ring = _ring()
+    with _lock:
+        return list(ring)
 
 
 def dump_flight_recorder(reason: str = "requested",
@@ -192,7 +201,7 @@ def dump_flight_recorder(reason: str = "requested",
     `IGG_TELEMETRY_DIR` when set.  Returns the paths written (empty when
     there is nowhere to write — the ring itself always remains readable
     via :func:`flight_recorder`)."""
-    recs = [r.as_dict() for r in _ring()]
+    recs = [r.as_dict() for r in flight_recorder()]
     doc = {"reason": reason, "wall": time.time(),
            "process": _process(), "events": recs}
     out: List[pathlib.Path] = []
@@ -235,6 +244,10 @@ def _auto_dump(reason: str) -> None:
 # ---------------------------------------------------------------------------
 
 _METRICS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "_Metric"] = {}
+# Name-level kind map: "one name, one type" must hold ACROSS label sets
+# too (a counter `x{a="1"}` next to a gauge `x{b="2"}` would render an
+# unparsable exposition — one `# TYPE x` line cannot cover both).
+_KIND_BY_NAME: Dict[str, type] = {}
 
 
 class _Metric:
@@ -331,6 +344,13 @@ def _get_metric(cls, name: str, labels: dict) -> _Metric:
         with _lock:
             m = _METRICS.get(key)
             if m is None:
+                have = _KIND_BY_NAME.get(name)
+                if have is not None and have is not cls:
+                    raise GridError(
+                        f"metric {name!r} is a {have.kind}, not a "
+                        f"{cls.kind} — one name, one type (across every "
+                        f"label set).")
+                _KIND_BY_NAME[name] = cls
                 m = _METRICS[key] = cls(name, lab)
     if not isinstance(m, cls):
         raise GridError(f"metric {name!r} is a {m.kind}, not a "
@@ -365,10 +385,21 @@ def reset_metrics() -> None:
     this for isolation)."""
     with _lock:
         _METRICS.clear()
+        _KIND_BY_NAME.clear()
 
 
 def _prom_name(name: str) -> str:
     return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _prom_label_value(v: str) -> str:
+    """Escape a label VALUE per the Prometheus text-format spec
+    (backslash, double-quote, and newline must be escaped inside the
+    quoted value) — a path-bearing or free-text label (e.g. a Windows
+    run directory, a captured error line) must not emit an unparsable
+    exposition."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 def prometheus_text() -> str:
@@ -388,8 +419,9 @@ def prometheus_text() -> str:
                  "histogram": "summary"}[kind]
         out.write(f"# TYPE {pname} {ptype}\n")
         for m in sorted(group, key=lambda g: g.labels):
-            lab = ("{" + ",".join(f'{_prom_name(k)}="{v}"'
-                                  for k, v in m.labels) + "}"
+            lab = ("{" + ",".join(
+                f'{_prom_name(k)}="{_prom_label_value(v)}"'
+                for k, v in m.labels) + "}"
                    if m.labels else "")
             if kind == "histogram":
                 out.write(f"{pname}_count{lab} {m.count}\n")
@@ -672,17 +704,30 @@ class StepStats:
     fetches — the device is never asked anything the watchdog did not
     already ask.  A drain that fetches several queued probes back-to-back
     yields near-zero deltas; those windows are skipped (`_MIN_DT`), not
-    extrapolated into nonsense rates."""
+    extrapolated into nonsense rates.
+
+    `perf` (a :func:`igg.perf.sample_context` dict) additionally feeds
+    each window's measured ms/step into the perf ledger, attributed to
+    the kernel tier(s) that served dispatches during the window
+    (:func:`igg.perf.observe_window`) — host-side ladder bookkeeping on
+    the same timestamps, so the zero-syncs contract is unchanged."""
 
     _MIN_DT = 1e-4
 
-    def __init__(self, run: str, members: Optional[int] = None):
+    def __init__(self, run: str, members: Optional[int] = None,
+                 perf: Optional[dict] = None):
         self.run = run
         self.members = members
         self._anchor: Optional[Tuple[int, float]] = None
         self._sps = gauge("igg_steps_per_s", run=run)
         self._lag = gauge("igg_watchdog_fetch_lag_steps", run=run)
         self._msps = (gauge("igg_member_steps_per_s") if members else None)
+        self._perf_ctx = perf
+        self._perf_state: Optional[dict] = None
+        if perf is not None:
+            from . import perf as _perf
+
+            self._perf_state = _perf.window_state()
 
     def fetched(self, probe_step: int, current_step: int,
                 active_members: Optional[int] = None) -> None:
@@ -710,6 +755,11 @@ class StepStats:
             if self._msps is not None:
                 self._msps.set(msps)
         emit("step_stats", step=probe_step, **payload)
+        if self._perf_ctx is not None:
+            from . import perf as _perf
+
+            _perf.observe_window(self.run, 1e3 / sps, dsteps,
+                                 self._perf_ctx, self._perf_state)
 
 
 # ---------------------------------------------------------------------------
@@ -765,19 +815,57 @@ def merge_streams(inputs: Sequence, output=None) -> List[dict]:
     return records
 
 
+def _records_from_dicts(dicts: Sequence[dict]) -> List[Record]:
+    """Re-hydrate merged JSONL dicts as :class:`Record`s (for feeding the
+    span exporter with cross-rank streams)."""
+    out = []
+    for r in dicts:
+        if not isinstance(r, dict):
+            continue
+        out.append(Record(
+            t=float(r.get("t", 0.0) or 0.0),
+            wall=float(r.get("wall", 0.0) or 0.0),
+            process=int(r.get("process", 0) or 0),
+            kind=str(r.get("kind", "")), step=r.get("step"),
+            payload=r.get("payload") if isinstance(r.get("payload"), dict)
+            else {}))
+    return out
+
+
 def _main(argv: Sequence[str]) -> int:
     import sys
 
-    usage = ("usage: python -m igg.telemetry merge <out.jsonl|-> "
-             "<events.jsonl|session-dir> [...]")
+    usage = ("usage: python -m igg.telemetry merge [--trace <trace.json>] "
+             "<out.jsonl|-> <events.jsonl|session-dir> [...]")
+    argv = list(argv)
     if len(argv) < 1 or argv[0] != "merge":
         print(usage, file=sys.stderr)
         return 2
-    if len(argv) < 3:
+    rest = argv[1:]
+    trace_out = None
+    if "--trace" in rest:
+        i = rest.index("--trace")
+        if i + 1 >= len(rest):
+            print(usage, file=sys.stderr)
+            return 2
+        trace_out = rest[i + 1]
+        del rest[i:i + 2]
+    if len(rest) < 2:
         print(usage, file=sys.stderr)
         return 2
-    out, inputs = argv[1], argv[2:]
+    out, inputs = rest[0], rest[1:]
     records = merge_streams(inputs, out)
+    if trace_out is not None:
+        # One merged Chrome-trace over every rank's spans: the span
+        # records of the wall-ordered merged stream, through the same
+        # exporter the per-rank sessions use — multi-rank timelines then
+        # open in Perfetto as a single overlaid view (timestamps are
+        # wall-clock microseconds already).
+        spans = [r for r in _records_from_dicts(records)
+                 if r.kind == "span"]
+        export_chrome_trace(trace_out, spans)
+        print(f"wrote merged Chrome trace ({len(spans)} span(s)) -> "
+              f"{trace_out}", file=sys.stderr)
     if out == "-":
         for r in records:
             print(json.dumps(r, default=str))

@@ -1,0 +1,264 @@
+"""Round-13 satellites: Prometheus label-value escaping, the name-level
+metric type-collision guard, StepStats edge behavior (first-fetch
+anchor, near-zero-dt suppression), snapshot() under concurrent
+observe(), benchmarks/run_all.py failed-stdout salvage + parents-created
+results dirs, and the telemetry merge CLI's --trace Chrome-trace
+emission."""
+
+import importlib.util
+import json
+import pathlib
+import sys
+import threading
+
+import pytest
+
+import igg
+from igg import telemetry as tel
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    tel.reset_metrics()
+    tel._ring().clear()
+    yield
+    for s in list(tel._SESSIONS):
+        s.detach()
+    tel.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# (i) Prometheus exposition: label-value escaping per the text-format spec
+# ---------------------------------------------------------------------------
+
+def test_prometheus_label_values_are_escaped():
+    # A path-bearing / free-text label value with every character the
+    # spec requires escaping: backslash, double-quote, newline.
+    tel.counter("igg_esc_total", run='C:\\runs\\r1 "smoke"\nline2').inc(2)
+    text = tel.prometheus_text()
+    line = next(l for l in text.splitlines()
+                if l.startswith("igg_esc_total{"))
+    assert line == ('igg_esc_total{run="C:\\\\runs\\\\r1 \\"smoke\\"'
+                    '\\nline2"} 2.0')
+    # The exposition stays line-parseable: no raw newline or unescaped
+    # quote inside the label braces of ANY line.
+    for l in text.splitlines():
+        if not l or l.startswith("#"):
+            continue
+        name, value = l.rsplit(" ", 1)
+        float(value)
+        inner = name[name.index("{") + 1:name.rindex("}")] \
+            if "{" in name else ""
+        assert "\n" not in inner
+        assert inner.count('"') % 2 == 0
+
+
+def test_prometheus_escape_helper():
+    assert tel._prom_label_value('a"b') == 'a\\"b'
+    assert tel._prom_label_value("a\\b") == "a\\\\b"
+    assert tel._prom_label_value("a\nb") == "a\\nb"
+    assert tel._prom_label_value("plain") == "plain"
+
+
+# ---------------------------------------------------------------------------
+# (ii) metric type collision is caught at the NAME level
+# ---------------------------------------------------------------------------
+
+def test_metric_type_collision_across_label_sets():
+    """PR 7 only caught a type collision at the exact (name, labels) key
+    — a counter `x{a=..}` next to a gauge `x{b=..}` slipped through and
+    rendered an exposition whose single `# TYPE x` line lies about one
+    of them.  One name, one type, across EVERY label set."""
+    tel.counter("igg_col_total", tier="a").inc()
+    with pytest.raises(igg.GridError, match="one name, one type"):
+        tel.gauge("igg_col_total", member="2")
+    with pytest.raises(igg.GridError, match="one name, one type"):
+        tel.histogram("igg_col_total")
+    # Same type, different labels: still fine.
+    tel.counter("igg_col_total", tier="b").inc()
+    # reset clears the name-level memory with the registry.
+    tel.reset_metrics()
+    tel.gauge("igg_col_total").set(1.0)
+
+
+# ---------------------------------------------------------------------------
+# (iii) StepStats edges: first-fetch anchor, tiny-dt suppression
+# ---------------------------------------------------------------------------
+
+def _stats_records():
+    return [r for r in tel.flight_recorder() if r.kind == "step_stats"]
+
+
+def test_stepstats_first_fetch_only_anchors(monkeypatch):
+    import time as _time
+
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(_time, "monotonic", lambda: clock["t"])
+    s = tel.StepStats("t_first")
+    s.fetched(10, 12)
+    # One fetch = an anchor, not a window: no rate can exist yet.
+    assert _stats_records() == []
+    assert tel.snapshot()['igg_steps_per_s{run="t_first"}']["value"] == 0.0
+    # ...but the fetch lag IS already known.
+    assert tel.snapshot()['igg_watchdog_fetch_lag_steps'
+                          '{run="t_first"}']["value"] == 2.0
+    clock["t"] += 2.0
+    s.fetched(30, 30)
+    recs = _stats_records()
+    assert len(recs) == 1
+    assert recs[0].payload["steps_per_s"] == pytest.approx(10.0)
+    assert recs[0].payload["window_steps"] == 20
+
+
+def test_stepstats_suppresses_drain_bursts(monkeypatch):
+    """A drain materializes several queued probes back-to-back: the
+    near-zero deltas (dt < _MIN_DT) must be skipped, not extrapolated
+    into nonsense rates; non-advancing probe steps are skipped too."""
+    import time as _time
+
+    clock = {"t": 500.0}
+    monkeypatch.setattr(_time, "monotonic", lambda: clock["t"])
+    s = tel.StepStats("t_burst")
+    s.fetched(10, 10)
+    clock["t"] += 1.0
+    s.fetched(20, 20)
+    assert len(_stats_records()) == 1
+    # Burst: three more probes land within a fraction of _MIN_DT.
+    for step in (30, 40, 50):
+        clock["t"] += tel.StepStats._MIN_DT / 10
+        s.fetched(step, 50)
+    assert len(_stats_records()) == 1      # all suppressed
+    # dsteps <= 0 (a re-probed step) is suppressed even with real dt.
+    clock["t"] += 5.0
+    s.fetched(50, 55)
+    assert len(_stats_records()) == 1
+    # The anchor kept moving: the next healthy window is measured from
+    # the LAST fetch, not from before the burst.
+    clock["t"] += 1.0
+    s.fetched(60, 60)
+    recs = _stats_records()
+    assert len(recs) == 2
+    assert recs[-1].payload["steps_per_s"] == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# (iv) snapshot() under concurrent observe()
+# ---------------------------------------------------------------------------
+
+def test_snapshot_under_concurrent_observe():
+    h = tel.histogram("igg_conc_lat")
+    c = tel.counter("igg_conc_total")
+    n_threads, per = 4, 2000
+    start = threading.Barrier(n_threads + 1)
+    snaps = []
+
+    def worker():
+        start.wait()
+        for i in range(per):
+            h.observe(float(i % 7))
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    start.wait()
+    # Snapshot (and render) WHILE observers hammer the registry: must
+    # never raise or return a torn histogram (count behind a concurrent
+    # read is fine; a crash or a key error is not).
+    for _ in range(50):
+        snap = tel.snapshot()
+        tel.prometheus_text()
+        if "igg_conc_lat" in snap:
+            assert snap["igg_conc_lat"]["count"] <= n_threads * per
+    for t in threads:
+        t.join()
+    snap = tel.snapshot()
+    assert snap["igg_conc_lat"]["count"] == n_threads * per
+    assert snap["igg_conc_lat"]["min"] == 0.0
+    assert snap["igg_conc_lat"]["max"] == 6.0
+    assert snap["igg_conc_total"]["value"] == float(n_threads * per)
+
+
+# ---------------------------------------------------------------------------
+# (v) benchmarks/run_all.py: failed-stdout salvage, parents created
+# ---------------------------------------------------------------------------
+
+def _run_all_mod():
+    spec = importlib.util.spec_from_file_location(
+        "igg_test_run_all", ROOT / "benchmarks" / "run_all.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_run_all_salvages_failed_partial_stdout(tmp_path, capsys):
+    ra = _run_all_mod()
+    script = tmp_path / "boom.py"
+    script.write_text(
+        "import sys\n"
+        "print('{\"metric\": \"partial\", \"value\": 1}')\n"
+        "print('dying now', file=sys.stderr)\n"
+        "sys.exit(3)\n")
+    results = tmp_path / "deep" / "nested" / "results"   # parents absent
+    with pytest.raises(SystemExit):
+        ra.run(str(script), [], tag="boom", results=results)
+    saved = results / "boom.failed.jsonl"
+    assert saved.exists()
+    assert json.loads(saved.read_text())["metric"] == "partial"
+    assert not (results / "boom.jsonl").exists()   # never a clean artifact
+    err = capsys.readouterr().err
+    assert "partial stdout" in err and "boom failed (exit 3)" in err
+
+
+def test_run_all_creates_result_parents_on_success(tmp_path):
+    ra = _run_all_mod()
+    script = tmp_path / "ok.py"
+    script.write_text("print('{\"metric\": \"fine\", \"value\": 2}')\n")
+    results = tmp_path / "also" / "missing" / "results"
+    ra.run(str(script), [], tag="ok", results=results)
+    assert json.loads((results / "ok.jsonl").read_text())["value"] == 2
+    assert not (results / "ok.failed.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# (vi) telemetry merge CLI: --trace emits one merged Chrome trace
+# ---------------------------------------------------------------------------
+
+def _span_line(process, wall, name):
+    return json.dumps({
+        "t": wall, "wall": wall, "process": process, "kind": "span",
+        "step": None,
+        "payload": {"name": name, "dur_s": 0.5, "wall0": wall,
+                    "tid": 7, "extra": "x"}})
+
+
+def test_merge_cli_trace_flag_merges_rank_spans(tmp_path):
+    (tmp_path / "events_r0.jsonl").write_text(
+        _span_line(0, 10.0, "ckpt") + "\n"
+        + json.dumps({"t": 11.0, "wall": 11.0, "process": 0,
+                      "kind": "rollback", "step": 5, "payload": {}})
+        + "\n")
+    (tmp_path / "events_r1.jsonl").write_text(
+        _span_line(1, 10.5, "rollback_load") + "\n")
+    trace = tmp_path / "merged_trace.json"
+    rc = tel._main(["merge", "--trace", str(trace),
+                    str(tmp_path / "merged.jsonl"), str(tmp_path)])
+    assert rc == 0
+    # The merged JSONL holds all three records, wall-ordered.
+    merged = [json.loads(l) for l in
+              (tmp_path / "merged.jsonl").read_text().splitlines()]
+    assert [r["kind"] for r in merged] == ["span", "span", "rollback"]
+    # The trace holds BOTH ranks' spans in one Perfetto-valid file.
+    doc = json.loads(trace.read_text())
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    assert {e["pid"] for e in evs} == {0, 1}
+    assert {e["name"] for e in evs} == {"ckpt", "rollback_load"}
+    assert all(e["ph"] == "X" and isinstance(e["ts"], float)
+               and e["dur"] == pytest.approx(0.5e6) for e in evs)
+    assert evs[0]["args"]["extra"] == "x"
+    # Flag plumbing: --trace without a value is a usage error.
+    assert tel._main(["merge", str(tmp_path / "m2.jsonl"),
+                      str(tmp_path), "--trace"]) == 2

@@ -380,6 +380,14 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
     observed = run(telemetry=tmp_path)
     assert bare > 0                      # the probes ARE being fetched
     assert observed == bare              # ...and telemetry added none
+    # Round 13: with the perf ledger enabled too (IGG_PERF_LEDGER set, a
+    # session attached), the watchdog-window attribution is host-side
+    # ladder bookkeeping riding the SAME fetches — still zero
+    # additional device-array materializations.
+    monkeypatch.setenv("IGG_PERF_LEDGER",
+                       str(tmp_path / "perf" / "ledger.json"))
+    with_perf = run(telemetry=tmp_path / "session2")
+    assert with_perf == bare
 
 
 # ---------------------------------------------------------------------------
