@@ -145,6 +145,13 @@ def main():
                 with ctx:
                     sec, gbps, ndims = bench((n, n, n), nfields, dtype,
                                              nt=nt, n_inner=n_inner)
+                # Comm ledger (igg.comm, round 14): every measured row is
+                # also a ledger sample (family "comm"), updating the
+                # igg_halo_gbps / igg_pct_link_peak gauges — bench rows
+                # and the comm roofline stay one store.
+                igg.comm.record_exchange(sec, local_shape=(n, n, n),
+                                         dtype=dtype, nfields=nfields,
+                                         source="bench", label=halo_dims)
                 emit({
                     "metric": "halo_exchange_bandwidth_per_chip",
                     "value": round(gbps, 2),
@@ -180,6 +187,9 @@ def main():
             with ctx:
                 sec, gbps, _ = bench((n, n), nfields, dtype, nt=nt,
                                      n_inner=n_inner)
+            igg.comm.record_exchange(sec, local_shape=(n, n), dtype=dtype,
+                                     nfields=nfields, source="bench",
+                                     label="xy_r2")
             emit({
                 "metric": "halo_exchange_bandwidth_per_chip",
                 "value": round(gbps, 2),
@@ -192,6 +202,48 @@ def main():
                            "platform": platform},
                 "us_per_update": round(sec * 1e6, 2),
             })
+    igg.finalize_global_grid()
+
+    # Byte-accounting cross-check (round 14, the always-present CPU-smoke
+    # contract row, golden-gated): one grouped update_halo must advance
+    # the igg_halo_plane_bytes_total counter by EXACTLY the analytic
+    # plane-bytes model (igg.comm.plane_bytes_model — the same accounting,
+    # callable) — deterministic host arithmetic, so any divergence is an
+    # accounting bug, not noise.
+    from igg import telemetry as tele
+
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+    fields = tuple(igg.zeros((n, n, n), dtype=np.float32) + i
+                   for i in range(2))
+
+    def counter_total():
+        snap = tele.snapshot()
+        return snap.get("igg_halo_plane_bytes_total", {}).get("value", 0.0)
+
+    before = counter_total()
+    igg.update_halo(*fields)
+    delta = counter_total() - before
+    model, by_mode = igg.comm.plane_bytes_model((n, n, n), np.float32,
+                                                nfields=2)
+    mismatch = abs(delta - model) / max(model, 1)
+    emit({
+        "metric": "halo_bytes_model_check",
+        "value": round(mismatch, 6),
+        "unit": "relative error (plane-bytes counter vs analytic model)",
+        "config": {"local": n, "fields": 2, "dtype": "float32",
+                   "devices": grid.nprocs, "dims": list(grid.dims),
+                   "platform": platform},
+        "counter_bytes": delta,
+        "model_bytes": model,
+        "by_mode": {f"{d}:{m}": b for (d, m), b in sorted(by_mode.items())},
+        "pass": bool(mismatch == 0.0),
+        "contract": "one grouped update_halo advances "
+                    "igg_halo_plane_bytes_total by exactly the analytic "
+                    "plane-bytes model (per (dim, mode) accounting "
+                    "reconciles)",
+    })
     igg.finalize_global_grid()
 
 

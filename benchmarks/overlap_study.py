@@ -77,6 +77,16 @@ def _study(model_run, metric_prefix, supported_fn, grid_kwargs,
         sec = median_of(lambda: model_run(nt, dtype=np.float32,
                                           n_inner=n_inner, **kv)[1])
         times[name] = sec
+        # Comm ledger (igg.comm, round 14): the measured variant times
+        # are ledger samples too (family "comm", tier
+        # "<metric_prefix>.<variant>"), so the overlap story and the
+        # autotuner prior live in one queryable store.
+        from igg import perf as iperf
+
+        iperf.record("comm", f"{metric_prefix}.{name}", sec * 1e3,
+                     source="bench", local_shape=(n, n, n),
+                     dtype="float32", dims=tuple(grid.dims),
+                     **iperf.device_context())
         emit({
             "metric": f"{metric_prefix}_{name}",
             "value": round(sec * 1e3, 4),
@@ -156,6 +166,53 @@ def study_wave2d(n, nt, n_inner, platform):
     igg.finalize_global_grid()
 
 
+def study_decomposition_smoke(platform):
+    """Round 14: the always-present CPU-smoke step-time decomposition
+    row (golden-gated) — `igg.comm.decompose` on a small radius-1
+    stencil, the production data path the per-variant model rows above
+    are a bench-side view of.  The contract is structural (the
+    decomposition is well-formed and emitted as a `comm_stats` record),
+    not a performance claim — on a single chip or a shared-core CPU mesh
+    there is no communication to hide (module docstring)."""
+    import igg
+    from igg.ops import interior_add
+
+    igg.init_global_grid(16, 16, 16, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+
+    def compute(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return interior_add(T, 0.1 * lap)
+
+    T = igg.update_halo(igg.zeros((16, 16, 16)) + 1.0)
+    d = igg.comm.decompose(compute, (T,), radius=1, nt=3, n_inner=5)
+    ok = (d["compute_ms"] > 0 and d["exchange_ms"] > 0
+          and d["hidden_ms"] > 0
+          and 0.0 <= d["exposed_comm_fraction"] <= 1.0)
+    emit({
+        "metric": "overlap_decomposition",
+        "value": round(d["exposed_comm_fraction"], 4),
+        "unit": "exposed-comm fraction",
+        "config": {"local": 16, "devices": grid.nprocs,
+                   "dims": list(grid.dims), "platform": platform},
+        "compute_ms": round(d["compute_ms"], 4),
+        "exchange_ms": round(d["exchange_ms"], 4),
+        "hidden_ms": round(d["hidden_ms"], 4),
+        "overlap_efficiency": round(d["overlap_efficiency"], 4)
+        if "overlap_efficiency" in d else None,
+        "pass": bool(ok),
+        "contract": "igg.comm.decompose yields a well-formed step-time "
+                    "decomposition (three positive variant times, "
+                    "exposed-comm fraction in [0, 1]) and emits it as a "
+                    "comm_stats record",
+    })
+    igg.finalize_global_grid()
+
+
 def main():
     import jax
 
@@ -179,6 +236,8 @@ def main():
     # 2-D wave (BASELINE config 3) at the 2-D local size with the same
     # cell count as the 3-D grids (n^1.5 squared = n^3).
     study_wave2d(max(int(n ** 1.5), 16), nt, n_inner, platform)
+    # Round 14: the always-emitted decomposition smoke/contract row.
+    study_decomposition_smoke(platform)
 
 
 if __name__ == "__main__":

@@ -68,7 +68,7 @@ def main():
         note("config 2b: diffusion3d weak scaling (fused-kernel tier)")
         weak_curve(lambda *a, **kw: d3.run(*a, use_pallas="auto", **kw),
                    "diffusion3d_pallas", n, nt=nt, n_inner=n_inner,
-                   full=full)
+                   full=full, tier="mosaic")
 
     # Config 4: HM3D weak scaling — the hide_communication workload (the
     # reference's published parallel-efficiency figure is the HM3D app,

@@ -36,7 +36,15 @@ GOLDENS = HERE / "goldens"
 # (generous, CPU-noise-sized) tolerance.  TPU evidence is never gated
 # against these: compare skips rows whose provenance
 # (backend, device_kind, smoke) does not match.
-GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput")
+GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput",
+               "halo_bandwidth", "overlap_study")
+# Tags whose goldens keep ONLY the contract rows (lines carrying a
+# "pass" flag): the comm benches' value rows are timer-noise-bound on
+# the shared smoke host (the halo_bandwidth docstring documents ~2x
+# spread at the tens-of-microseconds scale), so gating them would flake;
+# the contract rows (byte-accounting reconciliation, decomposition
+# well-formedness) are deterministic and gate strictly.
+GOLDEN_CONTRACT_ONLY = ("halo_bandwidth", "overlap_study")
 
 
 def run(script: str, args, *, virtual: int = 0, tag: str,
@@ -168,6 +176,8 @@ def update_goldens(results: pathlib.Path) -> None:
     artifacts (the documented workflow: `python benchmarks/run_all.py
     --quick --update-goldens` on the CI-shaped host, then commit
     `benchmarks/goldens/`)."""
+    import json
+
     GOLDENS.mkdir(parents=True, exist_ok=True)
     for tag in GOLDEN_TAGS:
         src = results / f"{tag}.jsonl"
@@ -175,8 +185,20 @@ def update_goldens(results: pathlib.Path) -> None:
             print(f"!!! update-goldens: {src} missing (run the benchmarks "
                   f"first)", file=sys.stderr)
             sys.exit(1)
-        (GOLDENS / f"{tag}.jsonl").write_text(src.read_text())
-        print(f"=== golden refreshed: goldens/{tag}.jsonl",
+        text = src.read_text()
+        if tag in GOLDEN_CONTRACT_ONLY:
+            kept = []
+            for line in text.splitlines():
+                try:
+                    if "pass" in json.loads(line):
+                        kept.append(line)
+                except (json.JSONDecodeError, TypeError):
+                    continue
+            text = "".join(l + "\n" for l in kept)
+        (GOLDENS / f"{tag}.jsonl").write_text(text)
+        print(f"=== golden refreshed: goldens/{tag}.jsonl"
+              + (" (contract rows only)"
+                 if tag in GOLDEN_CONTRACT_ONLY else ""),
               file=sys.stderr)
 
 

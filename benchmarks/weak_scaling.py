@@ -67,8 +67,14 @@ def run_once(model_run, devices, n: int, *, nt: int, n_inner: int,
 
     sec = median_of(one, reps=reps)
     dims = tuple(igg.get_global_grid().dims)
+    # The tier that actually served the last run's dispatches (the ladder
+    # state is cleared by finalize, so capture it here): an auto-elected
+    # run that fell back to XLA must not be ledger-labeled as the fast
+    # tier.  Unambiguous only when exactly one family dispatched.
+    served = list(igg.degrade.active().values())
+    served_tier = served[0] if len(served) == 1 else None
     igg.finalize_global_grid()
-    return sec, dims
+    return sec, dims, served_tier
 
 
 def device_counts(ndev: int):
@@ -81,11 +87,16 @@ def device_counts(ndev: int):
 
 
 def weak_curve(model_run, model_name: str, n: int, *, nt: int, n_inner: int,
-               full: bool, grid_kwargs=None, run_kwargs=None):
+               full: bool, grid_kwargs=None, run_kwargs=None,
+               tier: str = "xla"):
     """Weak-scaling curve for one model family over growing device counts —
     the single implementation behind `weak_scaling.py` and
     `benchmarks/pod_run.py`.  Emits one row per count in the schema
-    documented in the module docstring (plus `config.model`)."""
+    documented in the module docstring (plus `config.model`).  `tier`
+    is the FALLBACK ledger label for the caller's pinned kernel tier —
+    the recorded tier is what `igg.degrade.active()` says actually
+    served the run (an auto-elected run that fell back to XLA is never
+    mislabeled as the fast tier)."""
     import os
 
     import jax
@@ -95,9 +106,20 @@ def weak_curve(model_run, model_name: str, n: int, *, nt: int, n_inner: int,
     cores = os.cpu_count() or 1
     t1 = None
     for k in device_counts(len(devices)):
-        sec, dims = run_once(model_run, devices[:k], n, nt=nt,
-                             n_inner=n_inner, reps=3 if full else 1,
-                             grid_kwargs=grid_kwargs, run_kwargs=run_kwargs)
+        sec, dims, served_tier = run_once(
+            model_run, devices[:k], n, nt=nt, n_inner=n_inner,
+            reps=3 if full else 1, grid_kwargs=grid_kwargs,
+            run_kwargs=run_kwargs)
+        # Perf ledger (igg.perf, round 14): every weak-scaling point is a
+        # per-(dims, device count) ledger sample — the production data
+        # path behind the one-off curve, joinable with the comm ledger's
+        # exchange samples on the same (dims, backend, device_kind) axes.
+        from igg import perf as iperf
+
+        iperf.record(model_name, served_tier or f"{model_name}.{tier}",
+                     sec * 1e3, source="bench", local_shape=(n, n, n),
+                     dtype="float32", dims=tuple(dims),
+                     **iperf.device_context())
         coll = collective_us(devices[:k]) if platform == "cpu" else None
         if t1 is None:
             t1 = sec

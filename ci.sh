@@ -127,6 +127,46 @@ else
     exit 1
 fi
 
+# Round 14: communication observability.  With comm observability
+# enabled, run_resilient's hot loop pays one stall-heartbeat
+# registration/retirement + one comm_stats record + two gauge sets per
+# watch window — the contract is < 1% over the bare watchdog loop at
+# 128^3 watch_every=50 with ZERO additional device->host syncs (the
+# decomposition probes ride the loop's existing is_ready channel;
+# sentinel-asserted in tests/test_telemetry.py).  Sixth row of
+# resilience_overhead.py, emitted on every platform.
+if grep '"metric": "comm_overhead"' \
+        benchmarks/results_smoke/resilience_overhead.jsonl \
+        | grep -q '"pass": true'; then
+    echo "    comm_overhead smoke row PRESENT and within the <1%"
+    echo "    contract (resilience_overhead.jsonl)"
+else
+    echo "    comm_overhead smoke row MISSING or overhead >= 1%"
+    echo "    (benchmarks/results_smoke/resilience_overhead.jsonl)"
+    exit 1
+fi
+
+# Round 14: the halo-bandwidth byte-accounting golden must BITE — a
+# flipped contract flag against the committed golden has to fail the
+# gate (the goldens comparison in run_all --compare above proves the
+# green path for the new comm goldens; this proves the red one).
+echo "=== comm golden-gate proof (flipped halo_bytes_model_check pass"
+echo "    flag must fail igg.perf compare) ==="
+IGG_COMM_GATE_TMP=$(mktemp -d)
+sed 's/"pass": true/"pass": false/' benchmarks/goldens/halo_bandwidth.jsonl \
+    > "$IGG_COMM_GATE_TMP/new.jsonl"
+if python -m igg.perf compare benchmarks/goldens/halo_bandwidth.jsonl \
+        "$IGG_COMM_GATE_TMP/new.jsonl" --tol 3.0; then
+    echo "    halo-bandwidth golden gate FAILED to flag the flipped"
+    echo "    contract row"
+    rm -rf "$IGG_COMM_GATE_TMP"
+    exit 1
+else
+    echo "    halo-bandwidth golden gate correctly rejected the flipped"
+    echo "    contract row"
+fi
+rm -rf "$IGG_COMM_GATE_TMP"
+
 # Round 10: the degradation ladder.  verify="first_use" is a one-time
 # numeric check of each kernel tier against the pure-XLA truth; its cost
 # must amortize to < 1% of a 1000-step run on the serving tier (third
@@ -173,6 +213,13 @@ echo "    snapshot + Prometheus file + span trace; ResilienceError ->"
 echo "    flight-recorder auto-dump; python -m igg.telemetry merge) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/observed_run.py
+
+echo "=== communication observability end to end (comm ledger calibration"
+echo "    -> per-window step-time decomposition riding run_resilient ->"
+echo "    chaos-injected collective stall: event + stall_r0.json report +"
+echo "    flight dump; python -m igg.comm report; 8-device CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/comm_observed_run.py
 
 # Round 13: performance observability end to end.  A model-backed run on
 # the 8-device mesh fills the perf ledger (watchdog windows attributed

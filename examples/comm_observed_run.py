@@ -1,0 +1,175 @@
+"""Communication observability end to end: the comm ledger, per-window
+step-time decomposition, and a chaos-injected collective stall — all
+reconstructed from session artifacts ALONE.
+
+What `igg.comm` gives a production run (the same harness
+`tests/test_comm.py` drives, asserted here for `ci.sh`):
+
+1. **The comm ledger.**  `igg.comm.calibrate_comm` slope-times a
+   standalone grouped halo-exchange program and records the sample into
+   the perf ledger's comm section (family ``"comm"``, tier
+   ``halo.<set>.<path>``), persisted as versioned JSON under
+   ``IGG_PERF_LEDGER`` — the served exchange path's measured cost,
+   queryable after the run from the file alone.  On this CPU mesh the
+   ICI link peak is honestly ``None`` (no ``igg_pct_link_peak`` gauge —
+   the roofline is never invented).
+2. **Per-window decomposition.**  A `run_resilient` with a
+   `igg.comm.StepDecomposition` monitor attached (the ``comm=`` knob)
+   emits per-window ``comm_stats`` records — compute-only vs
+   compute+exchange vs hidden-overlap probe times, the exposed-comm
+   fraction, the overlap efficiency — riding the watchdog's async-fetch
+   cadence with ZERO additional device→host syncs.
+3. **Collective-stall detection.**  Under
+   `igg.chaos.collective_stall()` (every `is_ready` poll reports
+   not-ready — the hung-collective shape), the stall heartbeat fires:
+   a ``collective_stall`` event naming the in-flight exchange and the
+   last-completed step, a structured ``stall_r0.json`` report, and a
+   flight-recorder auto-dump — today's silent hang as artifacts.
+4. `python -m igg.comm report` renders the ledger + decomposition +
+   stall story from the artifacts.
+
+Run on TPU or on a virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/comm_observed_run.py
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg import comm as icomm
+
+
+def main(nx=8, nt=80):
+    tdir = pathlib.Path(tempfile.gettempdir()) / "igg_comm_observed_run"
+    shutil.rmtree(tdir, ignore_errors=True)
+    ledger = tdir / "ledger.json"
+    os.environ["IGG_PERF_LEDGER"] = str(ledger)
+    igg.perf.reset()
+
+    igg.init_global_grid(nx, nx, nx, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    grid = igg.get_global_grid()
+    me = grid.me
+
+    def say(msg):
+        if me == 0:
+            print(msg)
+
+    # ---- 1. the comm ledger: calibrate the served exchange path ----
+    say(f"comm observed run: calibrating the grouped halo-exchange path "
+        f"on dims={grid.dims}")
+    sample = icomm.calibrate_comm(nfields=2, n_inner=5, nt=3)
+    assert sample is not None and sample["path"] == "grouped", sample
+    assert sample["link_peak_gbps"] is None or sample["pct_link_peak"], \
+        sample   # CPU: honest None; TPU: a real percentage
+    igg.perf.save()
+    assert ledger.is_file(), ledger
+    doc = json.loads(ledger.read_text())
+    comm_entries = [e for e in doc["entries"].values()
+                    if e["family"] == "comm"]
+    assert comm_entries, sorted(doc["entries"])
+    say(f"  ledger sample (from {ledger.name} alone): "
+        f"{comm_entries[0]['tier']} best {comm_entries[0]['best_ms']:.4f} "
+        f"ms/update, {sample['gbps']:.3f} GB/s effective "
+        f"(link peak: {sample['link_peak_gbps']})")
+
+    # ---- 2. per-window decomposition under run_resilient ----
+    from igg.ops import interior_add
+
+    def compute(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return interior_add(T, 0.1 * lap)
+
+    @igg.sharded
+    def step(T):
+        return igg.update_halo_local(compute(T))
+
+    rng = np.random.default_rng(7)
+    T0 = igg.update_halo(igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (nx, nx, nx)))
+    monitor = icomm.StepDecomposition(compute, (T0,), radius=1, reps=2)
+    res = igg.run_resilient(lambda s: {"T": step(s["T"])}, {"T": T0}, nt,
+                            watch_every=2, telemetry=tdir,
+                            comm=monitor, install_sigterm=False)
+    assert res.steps_done == nt and monitor.windows >= 1, monitor.windows
+
+    events_file = tdir / "events_r0.jsonl"
+    records = [json.loads(l) for l in
+               events_file.read_text().splitlines()]
+    stats = [r for r in records if r["kind"] == "comm_stats"]
+    assert stats, [r["kind"] for r in records]
+    for r in stats:
+        p = r["payload"]
+        assert 0.0 <= p["exposed_comm_fraction"] <= 1.0, p
+        assert p["compute_ms"] > 0 and p["exchange_ms"] > 0, p
+    last = stats[-1]["payload"]
+    say(f"  {len(stats)} comm_stats window(s) from events_r0.jsonl alone; "
+        f"last: compute {last['compute_ms']:.3f} ms, exchange "
+        f"{last['exchange_ms']:.3f} ms, hidden {last['hidden_ms']:.3f} ms "
+        f"-> exposed-comm fraction {last['exposed_comm_fraction']:.3f}")
+
+    # ---- 3. chaos-injected collective stall ----
+    say("chaos: collective stall (is_ready never true) with "
+        "IGG_COMM_STALL_TIMEOUT=0.05")
+    os.environ["IGG_COMM_STALL_TIMEOUT"] = "0.05"
+    try:
+        with igg.chaos.collective_stall():
+            res2 = igg.run_resilient(
+                lambda s: (time.sleep(0.004), {"T": step(s["T"])})[1],
+                {"T": T0}, 40, watch_every=5, max_pending_probes=100,
+                telemetry=tdir, install_sigterm=False)
+    finally:
+        del os.environ["IGG_COMM_STALL_TIMEOUT"]
+    assert res2.steps_done == 40   # the drain force-fetches: no hang
+
+    records = [json.loads(l) for l in
+               events_file.read_text().splitlines()]
+    stalls = [r for r in records if r["kind"] == "collective_stall"]
+    assert stalls, "no collective_stall event"
+    st = stalls[0]
+    assert "watchdog probe" in st["payload"]["in_flight"]
+    assert st["payload"]["age_s"] >= 0.05
+    report = json.loads((tdir / "stall_r0.json").read_text())
+    assert report["reason"] == "collective_stall"
+    assert report["in_flight"] == st["payload"]["in_flight"]
+    dump = json.loads((tdir / "flight_r0.json").read_text())
+    assert "collective_stall" in dump["reason"], dump["reason"]
+    say(f"  collective_stall @ step {st['step']}: "
+        f"{st['payload']['in_flight']} not ready after "
+        f"{st['payload']['age_s']}s (last completed: "
+        f"{st['payload']['last_completed_step']}); stall_r0.json + "
+        f"flight_r0.json present")
+
+    # ---- 4. the report CLI over the artifacts ----
+    out = subprocess.run(
+        [sys.executable, "-m", "igg.comm", "report",
+         "--ledger", str(ledger), str(tdir)],
+        capture_output=True, text=True,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent))
+    assert out.returncode == 0, out.stderr
+    assert "comm ledger" in out.stdout
+    assert "step-time decomposition" in out.stdout
+    assert "collective stalls" in out.stdout
+    say("  python -m igg.comm report: ledger + decomposition + stall "
+        "tables rendered from the artifacts")
+
+    say("comm_observed_run: OK")
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,11 @@ spans; docs/observability.md), and the performance-observability layer
 (`igg.perf` — a persistent per-(family, tier, shape, dtype, topology)
 perf ledger feeding the future autotuner, live roofline and
 cost-model-drift gauges, and the `python -m igg.perf compare` benchmark
-regression gate).
+regression gate), and the communication-observability layer (`igg.comm`
+— the comm ledger + ICI roofline gauges, per-window step-time
+decomposition with exposed-comm fraction and overlap efficiency,
+per-rank skew, and the collective-stall heartbeat that turns hung
+collectives into structured artifacts).
 """
 
 from ._compat import install as _compat_install
@@ -102,6 +106,7 @@ from .ensemble import EnsembleResult, run_ensemble
 from .fleet import FleetResult, Job, JobOutcome, run_fleet
 from .timing import time_steps
 from . import chaos
+from . import comm
 from . import degrade
 from . import device
 from . import ensemble
@@ -135,6 +140,6 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
-    "telemetry", "Telemetry", "perf",
+    "telemetry", "Telemetry", "perf", "comm",
     "time_steps", "__version__",
 ]

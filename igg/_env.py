@@ -31,6 +31,9 @@ _KNOWN: Dict[str, str] = {
     "IGG_ASSEMBLY": "pin the measured halo-assembly election (xla|writer)",
     "IGG_CKPT_COMMIT_TIMEOUT":
         "seconds to wait for sharded-checkpoint commit coordination",
+    "IGG_COMM_STALL_TIMEOUT":
+        "seconds before an unfetched async probe is reported as a "
+        "collective stall (default 120; 0 disables the stall heartbeat)",
     "IGG_DIST_INIT_BACKOFF":
         "initial sleep between jax.distributed.initialize retries (s)",
     "IGG_DIST_INIT_TIMEOUT":

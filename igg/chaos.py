@@ -37,6 +37,11 @@ one-shot by default so a rolled-back replay does not re-fail:
   tier perturbs one interior output element by `magnitude` (a
   deterministic miscompile).  Host-level taps — never traced into
   compiled programs — so arming needs no cache clearing.
+- :func:`collective_stall` — a hung collective (round 14), injected
+  through the `igg.resilience._CHAOS_FETCH_TAP` probe-fetch seam: every
+  `is_ready` poll reports not-ready, so the stall heartbeat of
+  :mod:`igg.comm` must fire its `collective_stall` event, stall report,
+  and flight dump.  Host-level, no cache clearing.
 - :func:`scheduler_fault` / :func:`job_preempt_at` — the fleet queue's
   two failure shapes (round 11), through the `igg.fleet._CHAOS_JOB_TAP`
   seam: a job launch raises a stand-in launcher fault (the
@@ -69,7 +74,8 @@ from .shared import GridError
 
 __all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
            "HaloCorruption", "kernel_compile_fail", "kernel_corrupt",
-           "KernelChaos", "scheduler_fault", "job_preempt_at", "JobChaos",
+           "KernelChaos", "collective_stall", "FetchStall",
+           "scheduler_fault", "job_preempt_at", "JobChaos",
            "InjectedSchedulerFault", "armed"]
 
 
@@ -390,6 +396,56 @@ def kernel_corrupt(tier: str, magnitude: float = float("nan")) \
     heal on rollback — recovery requires demoting the tier
     (`igg.degrade.demote_active`, the `run_resilient` recovery rung)."""
     return KernelChaos("corrupt", tier, magnitude)
+
+
+class FetchStall:
+    """Armed collective-stall injection (see :func:`collective_stall`):
+    installs a never-ready predicate into the
+    `igg.resilience._CHAOS_FETCH_TAP` probe-fetch seam — the single
+    readiness primitive the watchdog's async probe fetches, the comm
+    decomposition probes, and the stall heartbeat all consult — so every
+    `is_ready` poll reports False while armed.  Host-level (consulted at
+    poll time, never traced into a program), so arming needs no cache
+    clearing; forced fetches (`np.asarray` at the pending-depth bound or
+    the end-of-run drain) still complete, because the underlying data IS
+    ready — only the readiness channel is stalled, which is exactly the
+    shape of a hung collective as the host observes it."""
+
+    def arm(self) -> "FetchStall":
+        from . import resilience
+
+        resilience._CHAOS_FETCH_TAP = lambda obj: False
+        return self
+
+    def disarm(self) -> None:
+        from . import resilience
+
+        resilience._CHAOS_FETCH_TAP = None
+
+    def __enter__(self) -> "FetchStall":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def collective_stall() -> FetchStall:
+    """Context manager making every async probe fetch report not-ready —
+    the deterministic stand-in for a collective hung on the interconnect
+    (a device that never completes the psum).  The stall heartbeat
+    (`igg.comm.StallWatchdog`, `IGG_COMM_STALL_TIMEOUT`) must detect the
+    over-age in-flight probe and emit a `collective_stall` event, a
+    `stall_r<rank>.json` report, and a flight-recorder dump::
+
+        with igg.chaos.collective_stall():
+            res = igg.run_resilient(step, state, n, watch_every=5,
+                                    max_pending_probes=100, ...)
+        assert any(e.kind == "collective_stall" for e in ...)
+
+    `max_pending_probes` is raised in the demonstration so the loop's
+    forced fetches don't retire the probe before the deadline expires;
+    the run still completes (the end-of-run drain force-fetches)."""
+    return FetchStall()
 
 
 class JobChaos:

@@ -1,0 +1,873 @@
+"""igg.comm — communication observability: the comm ledger, ICI roofline
+gauges, step-time decomposition, per-rank skew, and collective-stall
+detection.
+
+PR 7 made *incidents* observable and PR 8 made *compute performance*
+observable; this module points the same instruments at the wire.  The
+reference's headline claim is ~90% weak-scaling efficiency on thousands
+of devices, yet until now igg could count halo bytes
+(`igg_halo_plane_bytes_total`) without ever timing an exchange, admit in
+`benchmarks/overlap_study.py` that `hide_communication`'s performance
+case is unproven, and hang silently on a stuck collective.  Four pieces,
+all with the zero-host-sync discipline of PRs 7-8 (nothing here adds a
+device→host synchronization to a hot loop — the sentinel in
+`tests/test_telemetry.py` runs with comm observability enabled):
+
+- **The comm ledger.**  :func:`calibrate_comm` slope-times a standalone
+  grouped halo-exchange program (the `benchmarks/halo_bandwidth.py`
+  shape) and :func:`record_exchange` records the sample into the PR-8
+  perf ledger under family ``"comm"`` — the ledger's *comm section*,
+  keyed ``("comm", "halo.<set>.<path>", local_shape, dtype, dims,
+  backend, device_kind)`` where ``<set>`` names the moving dims (`xyz`,
+  `xy`, ...) and ``<path>`` the serving exchange path (``grouped`` —
+  one ppermute per (dim, side) for same-shaped planes — or ``stacked``,
+  the pair-emulated lane-active group program).  ``python -m igg.perf
+  show --family comm`` renders it; `python -m igg.comm report` joins it
+  with the event streams.
+
+- **ICI roofline gauges.**  Each sample updates ``igg_halo_gbps{path=}``
+  (effective GB/s over the logical halo bytes — the
+  `halo_bandwidth.py` accounting: 4 planes per field per moving dim,
+  per device) and, when the device kind has a published per-chip ICI
+  link peak AND the exchange actually crosses the wire,
+  ``igg_pct_link_peak{path=}`` over the wire-crossing subset.  CPU /
+  interpret meshes and unknown chips get an honest ``link_peak=None`` —
+  the gauge is omitted, never invented.  The analytic plane-bytes model
+  (:func:`plane_bytes_model`) is definitionally the same accounting the
+  ``igg_halo_plane_bytes_total`` counter performs, and
+  `benchmarks/halo_bandwidth.py` cross-checks the two every run (the
+  ``halo_bytes_model_check`` contract row).
+
+- **Step-time decomposition.**  :func:`decompose` (AOT) and
+  :class:`StepDecomposition` (in-run, `run_resilient(..., comm=)`) time
+  three variants of one step — compute-only, compute+exchange (the
+  plain composition), and :func:`igg.hide_communication` — and emit
+  per-window ``comm_stats`` records carrying the three times, the
+  **exposed-comm fraction** `(exchange − compute)/exchange`, and the
+  **overlap efficiency** `(exchange − hidden)/(exchange − compute)`.
+  The in-run probes are separately-dispatched programs on scratch
+  copies whose completion is observed through the SAME `is_ready()`
+  polling channel the watchdog already uses — never materialized, so
+  zero additional host syncs; each measurement is the delta between two
+  chained dispatches (the slope trick: queue time ahead of the pair
+  cancels), with poll-granularity error bounded by one loop iteration
+  per ``2·reps`` probe iterations.  This is the production data path
+  behind `benchmarks/overlap_study.py`'s one-off rows.
+
+- **Per-rank skew.**  Every step-stats window now also sets the
+  rank-tagged ``igg_rank_window_ms{run=}`` gauge (rank identity is the
+  per-rank ``metrics_r<rank>.prom`` file — the live straggler signal a
+  scraper can diff across ranks), and :func:`rank_skew` computes the
+  worst-vs-median window time per matching step across merged rank
+  streams, publishing ``igg_rank_skew_ms`` — the offline/merge-side
+  skew number `python -m igg.comm report` prints.  `python -m
+  igg.telemetry merge` additionally estimates per-rank wall-clock
+  offsets (median pairwise delta on matching-step records) in its
+  ``merge_summary`` record so cross-rank timelines are not misread
+  through host clock drift.
+
+- **Collective-stall detection.**  :class:`StallWatchdog` is a
+  host-side heartbeat THREAD (it must be a thread: a truly hung
+  collective blocks the run loop inside its next forced fetch, so only
+  another thread can still speak).  `run_resilient` registers every
+  async probe dispatch with it and deregisters on fetch; when the
+  oldest in-flight probe exceeds ``IGG_COMM_STALL_TIMEOUT`` seconds
+  (default 120; 0 disables) and still reports not-ready, the watchdog
+  emits a ``collective_stall`` event naming the last-completed step and
+  the in-flight exchange, writes a structured ``stall_r<rank>.json``
+  report into every attached telemetry sink, and auto-dumps the flight
+  recorder — today's silent hang becomes an actionable artifact.  One
+  event per stall episode (a subsequent successful fetch re-arms it).
+  Deterministically provable via :func:`igg.chaos.collective_stall`
+  (the probe-fetch seam).
+
+`python -m igg.comm report [--ledger ledger.json] <session-dirs...>`
+renders the comm ledger, the per-window decomposition table, the
+per-step rank-skew table, and any stall events from the artifacts
+alone; ``examples/comm_observed_run.py`` (run by ci.sh) proves the
+whole loop end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import _env
+from . import shared
+from . import telemetry as _telemetry
+from .shared import GridError
+
+__all__ = [
+    "plane_bytes_model", "link_peak_gbps", "record_exchange",
+    "calibrate_comm", "decompose", "StepDecomposition", "StallWatchdog",
+    "make_stall_watchdog", "rank_skew",
+]
+
+
+# ---------------------------------------------------------------------------
+# ICI link-peak table
+# ---------------------------------------------------------------------------
+
+# Published per-chip aggregate ICI bandwidth, GB/s (Gbps figures from the
+# public TPU system documentation, divided by 8), matched by substring
+# against the lowercased jax `device_kind`.  Chips without a
+# well-published figure — and every CPU/interpret mesh — honestly return
+# None: the `igg_pct_link_peak` gauge is then OMITTED, never invented.
+_ICI_LINK_PEAK_TABLE: Sequence[Tuple[str, float]] = (
+    ("v6e", 448.0), ("v6 lite", 448.0),   # 3,584 Gbps
+    ("v5p", 600.0),                       # 4,800 Gbps
+    ("v5e", 200.0), ("v5 lite", 200.0),   # 1,600 Gbps
+    ("v4", 300.0),                        # 2,400 Gbps
+)
+
+
+def link_peak_gbps(device_kind: Optional[str]) -> Optional[float]:
+    """Published per-chip aggregate ICI bandwidth (GB/s) for a jax
+    `device_kind`, or None when unknown (CPU hosts and unlisted chips —
+    the honest answer, so no gauge lies)."""
+    if not device_kind:
+        return None
+    dk = str(device_kind).lower()
+    if "tpu" not in dk:
+        return None
+    for pat, val in _ICI_LINK_PEAK_TABLE:
+        if pat in dk:
+            return val
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The analytic plane-bytes model (the counter's accounting, callable)
+# ---------------------------------------------------------------------------
+
+def plane_bytes_model(local_shape, dtype, *, nfields: int = 1, grid=None
+                      ) -> Tuple[int, Dict[Tuple[str, str], int]]:
+    """Analytic halo-plane bytes of ONE `update_halo` call for `nfields`
+    same-shaped fields of `dtype` on `local_shape` blocks: returns
+    ``(total, {(dim, mode): bytes})`` — by construction the SAME
+    accounting the ``igg_halo_plane_bytes_total`` counter performs (each
+    exchanged plane counted once per device side, summed over the mesh),
+    so counter deltas reconcile exactly against this model
+    (`benchmarks/halo_bandwidth.py`'s ``halo_bytes_model_check`` row and
+    `tests/test_comm.py` assert it).  Modes are
+    ``{wire|local}_{grouped|stacked}`` (`igg.halo.plane_bytes_by_mode`)."""
+    from . import halo
+
+    g = grid if grid is not None else shared.global_grid()
+    shapes = [tuple(local_shape)] * int(nfields)
+    dtypes = [dtype] * int(nfields)
+    by_mode = halo.plane_bytes_by_mode(shapes, dtypes, g)
+    return sum(by_mode.values()), by_mode
+
+
+def _exchange_accounting(local_shape, dtype, nfields: int, grid) -> Dict:
+    """Per-device logical traffic of one grouped update — the
+    `halo_bandwidth.py` accounting (4 planes per field per moving dim:
+    2 sent + 2 received per device) — split into the total and the
+    wire-crossing subset, plus the serving-path classification."""
+    from . import halo
+
+    local_shape = tuple(local_shape)
+    itemsize = np.dtype(dtype).itemsize
+    elems = 1
+    for s in local_shape:
+        elems *= int(s)
+    moving = halo.moving_dims(halo.active_dims(local_shape, grid), grid)
+    total = wire = 0
+    dims_label = ""
+    for d, _ in moving:
+        b = nfields * 4 * (elems // int(local_shape[d])) * itemsize
+        total += b
+        if grid.dims[d] > 1:
+            wire += b
+        dims_label += "xyz"[d] if d < 3 else str(d)
+    _, by_mode = plane_bytes_model(local_shape, dtype, nfields=nfields,
+                                   grid=grid)
+    path = ("stacked" if any(m.endswith("stacked") for _, m in by_mode)
+            else "grouped")
+    return {"bytes_per_update": total, "wire_bytes_per_update": wire,
+            "moving_dims": [d for d, _ in moving],
+            "dims_label": dims_label or "-", "path": path}
+
+
+# ---------------------------------------------------------------------------
+# The comm ledger + ICI roofline gauges
+# ---------------------------------------------------------------------------
+
+def record_exchange(sec_per_update: float, *, local_shape, dtype,
+                    nfields: int = 1, source: str = "calibrate",
+                    label: Optional[str] = None) -> Optional[Dict]:
+    """Record one measured halo-exchange sample: a perf-ledger entry
+    under family ``"comm"`` (tier ``halo.<set>.<path>``), the
+    ``igg_halo_gbps{path=}`` gauge over the logical halo bytes, the
+    ``igg_pct_link_peak{path=}`` gauge when the device kind has a
+    published ICI peak AND the exchange crosses the wire (otherwise the
+    gauge is omitted — a single-chip self-wrap update is HBM traffic,
+    not link traffic), and a ``comm_sample`` bus record.  Returns the
+    sample dict, or None for an unusable measurement."""
+    from . import perf
+
+    try:
+        sec = float(sec_per_update)
+    except (TypeError, ValueError):
+        return None
+    if not (sec > 0):
+        return None
+    grid = shared.global_grid()
+    acct = _exchange_accounting(local_shape, dtype, nfields, grid)
+    ctx = perf.device_context()
+    gbps = acct["bytes_per_update"] / sec / 1e9
+    peak = link_peak_gbps(ctx.get("device_kind"))
+    pct = None
+    if peak and acct["wire_bytes_per_update"]:
+        pct = 100.0 * (acct["wire_bytes_per_update"] / sec / 1e9) / peak
+    tier = f"halo.{label or acct['dims_label']}.{acct['path']}"
+    perf.record("comm", tier, sec * 1e3, source=source,
+                local_shape=tuple(local_shape),
+                dtype=str(np.dtype(dtype)), dims=tuple(grid.dims),
+                backend=ctx.get("backend"),
+                device_kind=ctx.get("device_kind"))
+    _telemetry.gauge("igg_halo_gbps", path=acct["path"]).set(gbps)
+    if pct is not None:
+        _telemetry.gauge("igg_pct_link_peak", path=acct["path"]).set(pct)
+    sample = {"tier": tier, "seconds_per_update": sec, "gbps": gbps,
+              "bytes_per_update": acct["bytes_per_update"],
+              "wire_bytes_per_update": acct["wire_bytes_per_update"],
+              "link_peak_gbps": peak, "pct_link_peak": pct,
+              "path": acct["path"], "nfields": int(nfields),
+              "local_shape": list(local_shape),
+              "dtype": str(np.dtype(dtype)), "dims": list(grid.dims),
+              "source": source, **ctx}
+    _telemetry.emit("comm_sample", **sample)
+    return sample
+
+
+def calibrate_comm(nfields: int = 1, dtype=np.float32, *,
+                   local_shape=None, n_inner: int = 10, nt: int = 4,
+                   assembly=None, source: str = "calibrate"
+                   ) -> Optional[Dict]:
+    """Slope-time a STANDALONE grouped halo-exchange program for the
+    live grid — `nfields` fresh blocks of `dtype` through
+    :func:`igg.update_halo_local`, `n_inner` updates per compiled
+    dispatch (the `benchmarks/halo_bandwidth.py` measurement shape) —
+    and record the sample into the comm ledger via
+    :func:`record_exchange`.  `local_shape` defaults to the grid's
+    per-device block.  Returns the sample dict (None when no dimension
+    moves on this mesh — there is nothing to measure)."""
+    import jax
+    from jax import lax
+
+    import igg
+    from . import halo
+    from .fields import spec_for
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    local_shape = tuple(local_shape) if local_shape is not None \
+        else tuple(grid.nxyz)
+    if not halo.moving_dims(halo.active_dims(local_shape, grid), grid):
+        return None
+    nfields = int(nfields)
+
+    def mkfields():
+        return tuple(igg.zeros(local_shape, dtype=dtype) + i
+                     for i in range(nfields))
+
+    spec = spec_for(len(local_shape))
+
+    def body(*fs):
+        def it(_, fs):
+            out = igg.update_halo_local(*fs, assembly=assembly)
+            return out if isinstance(out, tuple) else (out,)
+        return lax.fori_loop(0, n_inner, it, fs)
+
+    fn = jax.jit(jax.shard_map(body, mesh=grid.mesh,
+                               in_specs=(spec,) * nfields,
+                               out_specs=(spec,) * nfields),
+                 donate_argnums=tuple(range(nfields)))
+    _, sec = igg.time_steps(fn, mkfields(), n1=max(1, nt),
+                            n2=3 * max(1, nt), warmup=1)
+    return record_exchange(sec / n_inner, local_shape=local_shape,
+                           dtype=dtype, nfields=nfields, source=source)
+
+
+# ---------------------------------------------------------------------------
+# Step-time decomposition: compute-only / plain exchange / hidden overlap
+# ---------------------------------------------------------------------------
+
+def _build_variant(compute, nf: int, naux: int, specs, aux_specs, grid,
+                   variant: str, reps: int, radius: int, assembly):
+    """One jitted SPMD program applying `reps` iterations of the named
+    step variant to an `nf`-field state (aux fields ride along
+    read-only)."""
+    import jax
+    from jax import lax
+
+    from .halo import update_halo_local
+    from .overlap import hide_communication
+
+    def body(fs, ax):
+        if variant == "compute":
+            out = compute(*fs, *ax)
+        elif variant == "exchange":
+            out = compute(*fs, *ax)
+            out = out if isinstance(out, tuple) else (out,)
+            out = update_halo_local(*out, assembly=assembly)
+        elif variant == "hidden":
+            arg = fs[0] if nf == 1 else tuple(fs)
+            out = hide_communication(arg, compute, *ax, radius=radius,
+                                     assembly=assembly)
+        else:   # pragma: no cover - internal
+            raise GridError(f"unknown variant {variant!r}")
+        return out if isinstance(out, tuple) else (out,)
+
+    def prog(*args):
+        fs, ax = tuple(args[:nf]), tuple(args[nf:])
+
+        def it(_, fs):
+            return body(fs, ax)
+
+        return lax.fori_loop(0, reps, it, fs)
+
+    sm = jax.shard_map(prog, mesh=grid.mesh, in_specs=specs + aux_specs,
+                       out_specs=specs)
+    return jax.jit(sm)
+
+
+_VARIANTS = ("compute", "exchange", "hidden")
+
+
+def _fractions(times_ms: Dict[str, float]) -> Dict[str, float]:
+    """Exposed-comm fraction and overlap efficiency from the three
+    variant times (ms), clamped to their meaningful ranges — timer noise
+    can invert orderings on a shared smoke host, and a fraction outside
+    [0, 1] would only mislead."""
+    comp = times_ms["compute"]
+    exch = times_ms["exchange"]
+    hid = times_ms["hidden"]
+    out = dict(compute_ms=comp, exchange_ms=exch, hidden_ms=hid)
+    exposed = max(0.0, (exch - comp) / exch) if exch > 0 else 0.0
+    out["exposed_comm_fraction"] = min(1.0, exposed)
+    out["overlap_speedup"] = (exch / hid) if hid > 0 else 0.0
+    if exch > comp:
+        eff = (exch - hid) / (exch - comp)
+        out["overlap_efficiency"] = max(0.0, min(1.0, eff))
+    return out
+
+
+def decompose(compute, fields, *, aux=(), radius: int = 1, assembly=None,
+              nt: int = 4, n_inner: int = 5, record: bool = True) -> Dict:
+    """AOT step-time decomposition: slope-time the compute-only,
+    compute+exchange, and hidden-overlap variants of one step
+    (:func:`igg.time_steps` — the constant dispatch latency cancels) and
+    emit one ``comm_stats`` record (source ``"calibrate"``).  `compute`
+    is a shift-invariant, shape-preserving local stencil exactly as
+    :func:`igg.hide_communication` requires; `fields`/`aux` are
+    block-stacked grid arrays (scratch copies are taken — the caller's
+    arrays are not consumed).  With `record`, each variant also lands in
+    the comm ledger (family ``"comm"``, tier ``overlap.<variant>``).
+    Returns the times and fractions dict (see :func:`_fractions`)."""
+    import igg
+    from . import perf
+    from .fields import spec_for
+
+    shared.check_initialized()
+    grid = shared.global_grid()
+    fields = tuple(fields) if isinstance(fields, (tuple, list)) else (fields,)
+    aux = tuple(aux)
+    nf = len(fields)
+    specs = tuple(spec_for(f.ndim) for f in fields)
+    aux_specs = tuple(spec_for(a.ndim) for a in aux)
+    times_ms: Dict[str, float] = {}
+    for variant in _VARIANTS:
+        fn = _build_variant(compute, nf, len(aux), specs, aux_specs, grid,
+                            variant, n_inner, radius, assembly)
+        scratch = tuple(f + 0 for f in fields)
+
+        def stepper(*args):
+            return fn(*args) + tuple(args[nf:])
+
+        _, sec = igg.time_steps(stepper, scratch + aux, n1=max(1, nt),
+                                n2=3 * max(1, nt), warmup=1)
+        times_ms[variant] = sec / n_inner * 1e3
+    out = _fractions(times_ms)
+    ctx = perf.device_context()
+    if record:
+        for variant, ms in times_ms.items():
+            perf.record("comm", f"overlap.{variant}", ms,
+                        source="calibrate",
+                        local_shape=tuple(grid.local_shape(fields[0])),
+                        dtype=str(fields[0].dtype),
+                        dims=tuple(grid.dims), backend=ctx.get("backend"),
+                        device_kind=ctx.get("device_kind"))
+    _telemetry.gauge("igg_exposed_comm_fraction",
+                     run="calibrate").set(out["exposed_comm_fraction"])
+    _telemetry.emit("comm_stats", run="calibrate", source="calibrate",
+                    n_inner=n_inner, **out)
+    return out
+
+
+class StepDecomposition:
+    """In-run step-time decomposition — the production data path behind
+    `benchmarks/overlap_study.py`, riding :func:`igg.run_resilient`'s
+    watch cadence (the ``comm=`` knob).
+
+    Three probe programs (compute-only / compute+exchange /
+    hidden-overlap, built from the SAME `compute` the caller's step
+    uses) run on device-resident scratch copies, dispatched round-robin
+    one variant per watch window.  Each measurement is a pair of
+    back-to-back chained dispatches (`reps` and `2·reps` iterations):
+    the device executes them adjacently, so the host-observed delta
+    between their completions — watched through the same non-blocking
+    `is_ready()` polling the watchdog already performs — is the second
+    batch's execution time, with queue time ahead of the pair cancelled
+    (the slope trick) and poll-granularity error bounded by one loop
+    iteration per `2·reps` probe iterations.  Nothing is ever
+    materialized: ZERO additional device→host syncs (the sentinel in
+    `tests/test_telemetry.py` runs with a monitor attached).  Deltas
+    under `_MIN_DT` (both batches ready inside one poll interval) are
+    discarded and the variant retried, not extrapolated.
+
+    When all three variants have a measurement, one ``comm_stats``
+    record (source ``"probe"``) is emitted with the times and fractions
+    (:func:`_fractions`), the ``igg_exposed_comm_fraction{run=}`` /
+    ``igg_overlap_efficiency{run=}`` gauges are updated, and the
+    rotation restarts — per-window decomposition for as long as the run
+    lasts.  Single-controller only (probe dispatch depends on local
+    readiness timing; `run_resilient` warns it off on multi-process
+    runs, the `verify="first_use"` precedent)."""
+
+    _MIN_DT = 1e-4
+
+    def __init__(self, compute, fields, *, aux=(), radius: int = 1,
+                 assembly=None, reps: int = 4, run: str = "resilient"):
+        from .fields import spec_for
+
+        shared.check_initialized()
+        grid = shared.global_grid()
+        fields = (tuple(fields) if isinstance(fields, (tuple, list))
+                  else (fields,))
+        self._aux = tuple(aux)
+        self._nf = len(fields)
+        self._reps = max(1, int(reps))
+        self.run = run
+        # Device-side scratch copies: the caller's state is never touched
+        # (and never donated), so the monitor cannot perturb the run.
+        self._scratch = tuple(f + 0 for f in fields)
+        specs = tuple(spec_for(f.ndim) for f in fields)
+        aux_specs = tuple(spec_for(a.ndim) for a in self._aux)
+        self._progs = {}
+        for variant in _VARIANTS:
+            self._progs[variant] = (
+                _build_variant(compute, self._nf, len(self._aux), specs,
+                               aux_specs, grid, variant, self._reps,
+                               radius, assembly),
+                _build_variant(compute, self._nf, len(self._aux), specs,
+                               aux_specs, grid, variant, 2 * self._reps,
+                               radius, assembly))
+        # AOT warm-up: compile + run each probe pair ONCE here, where
+        # setup cost is expected — a lazy first compile inside the run
+        # loop would stall exactly the watch window whose step_stats /
+        # rank-window gauges this subsystem measures.
+        import jax
+
+        args = self._scratch + self._aux
+        for fn_a, fn_b in self._progs.values():
+            jax.block_until_ready(fn_a(*args))
+            jax.block_until_ready(fn_b(*args))
+        self._i = 0                       # next variant index
+        self._pending = None   # (variant, step, out_a, out_b, t_a)
+        self._times_ms: Dict[str, float] = {}
+        self.windows = 0                  # comm_stats records emitted
+        self._g_exposed = _telemetry.gauge("igg_exposed_comm_fraction",
+                                           run=run)
+        self._g_eff = _telemetry.gauge("igg_overlap_efficiency", run=run)
+
+    # -- the run-loop surface ---------------------------------------------
+    def maybe_dispatch(self, step: int, stall=None) -> bool:
+        """Dispatch the next variant's chained probe pair (one variant
+        per watch window; skipped while a pair is still in flight)."""
+        if self._pending is not None:
+            return False
+        variant = _VARIANTS[self._i % len(_VARIANTS)]
+        fn_a, fn_b = self._progs[variant]
+        args = self._scratch + self._aux
+        out_a = fn_a(*args)
+        out_b = fn_b(*args)   # adjacent in the device stream: the pair
+        self._pending = (variant, step, out_a[0], out_b[0], None)
+        if stall is not None:
+            stall.watch(("comm", variant, step), step,
+                        f"comm decomposition probe ({variant})", out_b[0])
+        return True
+
+    def poll(self, step: int, stall=None) -> Optional[Dict]:
+        """Non-blocking readiness check (called once per loop iteration,
+        like the watchdog's probe polling); emits and returns the
+        ``comm_stats`` dict when a rotation completes."""
+        if self._pending is None:
+            return None
+        from .resilience import _is_ready
+
+        variant, p_step, out_a, out_b, t_a = self._pending
+        now = time.monotonic()
+        if t_a is None:
+            if not _is_ready(out_a):
+                return None
+            self._pending = (variant, p_step, out_a, out_b, now)
+            return None
+        if not _is_ready(out_b):
+            return None
+        if stall is not None:
+            stall.fetched(("comm", variant, p_step), p_step)
+        self._pending = None
+        dt = now - t_a
+        if dt < self._MIN_DT:
+            return None   # both batches inside one poll interval: retry
+        self._times_ms[variant] = dt / (2 * self._reps) * 1e3
+        self._i += 1
+        if not all(v in self._times_ms for v in _VARIANTS):
+            return None
+        out = _fractions(self._times_ms)
+        self._times_ms = {}
+        self.windows += 1
+        self._g_exposed.set(out["exposed_comm_fraction"])
+        if "overlap_efficiency" in out:
+            self._g_eff.set(out["overlap_efficiency"])
+        _telemetry.emit("comm_stats", step=step, run=self.run,
+                        source="probe", reps=self._reps, **out)
+        return out
+
+    def finalize(self, step: int, timeout_s: float = 10.0) -> None:
+        """End-of-run drain: give the in-flight pair a bounded window to
+        complete (spinning on `is_ready`, still never materializing), so
+        a short run's last rotation is not silently lost."""
+        deadline = time.monotonic() + timeout_s
+        while self._pending is not None and time.monotonic() < deadline:
+            if self.poll(step) is not None:
+                break
+            time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# Collective-stall detection
+# ---------------------------------------------------------------------------
+
+class StallWatchdog:
+    """Host-side heartbeat thread that turns a hung collective into an
+    actionable artifact (module docstring).  `watch(key, step, what,
+    obj)` registers an in-flight async fetch; `fetched(key, step)`
+    retires it (and re-arms stall detection).  When the OLDEST in-flight
+    entry exceeds `timeout_s` and its array still reports not-ready
+    (through :func:`igg.resilience._is_ready` — the chaos-tappable
+    probe-fetch seam), the watchdog fires ONCE per stall episode:
+
+    - a ``collective_stall`` bus record (step, in-flight description,
+      age, last-completed step, pending depth) — flight recorder + any
+      attached session sink;
+    - a structured ``stall_r<rank>.json`` report into every attached
+      telemetry sink (and ``IGG_TELEMETRY_DIR``);
+    - a flight-recorder auto-dump (reason ``collective_stall ...``).
+
+    Pure host bookkeeping (a dict insert/pop per probe); the thread
+    starts lazily on the first `watch` and is joined by `close()`.
+    Size the timeout above `max_pending_probes` watch windows — a probe
+    legitimately waits that long before the loop force-fetches it."""
+
+    def __init__(self, timeout_s: float, *, run: str = "resilient",
+                 poll_s: Optional[float] = None):
+        self.timeout_s = float(timeout_s)
+        self.run = run
+        self._poll_s = (float(poll_s) if poll_s is not None
+                        else min(1.0, max(0.005, self.timeout_s / 5.0)))
+        self._lock = threading.Lock()
+        self._inflight: Dict = {}          # key -> (step, what, obj, t0)
+        self._last_completed: Optional[int] = None
+        self._stalled = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stalls = 0
+
+    def watch(self, key, step: int, what: str, obj=None) -> None:
+        with self._lock:
+            self._inflight[key] = (int(step), str(what), obj,
+                                   time.monotonic())
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"igg-stall-{self.run}",
+                    daemon=True)
+                self._thread.start()
+
+    def fetched(self, key, step: Optional[int] = None) -> None:
+        with self._lock:
+            self._inflight.pop(key, None)
+            if step is not None:
+                self._last_completed = (step if self._last_completed is None
+                                        else max(self._last_completed, step))
+            # Episode over only when the channel fully drains: a single
+            # fetch while OTHER over-age probes are still in flight (the
+            # end-of-run drain retiring them one by one) must not re-arm
+            # mid-drain and double-report one stall.
+            if not self._inflight:
+                self._stalled = False
+
+    def clear(self) -> None:
+        """Forget every in-flight entry (the run loop's `pending.clear()`
+        counterpart on rollback); the next stall is a new episode."""
+        with self._lock:
+            self._inflight.clear()
+            self._stalled = False
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # -- the heartbeat -----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.check()
+            except Exception:   # a broken probe must not kill the thread
+                continue
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One heartbeat (separable for tests): fire if the oldest
+        in-flight entry is over-age and still not ready.  Returns
+        whether a stall was reported."""
+        from .resilience import _is_ready
+
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._stalled or not self._inflight:
+                return False
+            key = min(self._inflight, key=lambda k: self._inflight[k][3])
+            step, what, obj, t0 = self._inflight[key]
+            age = now - t0
+            pending = len(self._inflight)
+            last = self._last_completed
+        if age <= self.timeout_s:
+            return False
+        if obj is not None and _is_ready(obj):
+            return False   # unfetched but complete: slow host, not a stall
+        self._fire(step, what, age, pending, last)
+        return True
+
+    def _fire(self, step, what, age, pending, last_completed) -> None:
+        with self._lock:
+            self._stalled = True
+            self.stalls += 1
+        payload = {"run": self.run, "in_flight": what,
+                   "age_s": round(age, 3), "timeout_s": self.timeout_s,
+                   "last_completed_step": last_completed,
+                   "pending": pending}
+        _telemetry.emit("collective_stall", step=step, **payload)
+        self._write_reports({"reason": "collective_stall", "step": step,
+                             "wall": time.time(),
+                             "process": _telemetry._process(), **payload})
+        _telemetry._auto_dump(
+            f"collective_stall: {what} dispatched at step {step} not ready "
+            f"after {age:.1f}s (timeout {self.timeout_s:g}s)")
+
+    @staticmethod
+    def _write_reports(doc: dict) -> List[pathlib.Path]:
+        """`stall_r<rank>.json` into every attached session dir and
+        `IGG_TELEMETRY_DIR` (atomic; write failures never mask the
+        stall)."""
+        rank = _telemetry._process()
+        targets: List[pathlib.Path] = []
+        with _telemetry._lock:
+            sessions = list(_telemetry._SESSIONS)
+        for s in sessions:
+            targets.append(s.dir / f"stall_r{rank}.json")
+        envdir = _env.text("IGG_TELEMETRY_DIR")
+        if envdir:
+            p = pathlib.Path(envdir) / f"stall_r{rank}.json"
+            if p not in targets:
+                targets.append(p)
+        out = []
+        for t in targets:
+            try:
+                t.parent.mkdir(parents=True, exist_ok=True)
+                tmp = t.with_name(t.name + ".tmp")
+                tmp.write_text(json.dumps(doc, default=str))
+                os.replace(tmp, t)
+                out.append(t)
+            except OSError:
+                continue
+        return out
+
+
+def make_stall_watchdog(run: str = "resilient") -> Optional[StallWatchdog]:
+    """The run loops' factory: a :class:`StallWatchdog` honoring
+    ``IGG_COMM_STALL_TIMEOUT`` (seconds, default 120; 0 disables —
+    returns None)."""
+    timeout = _env.number("IGG_COMM_STALL_TIMEOUT", 120.0)
+    if timeout <= 0:
+        return None
+    return StallWatchdog(timeout, run=run)
+
+
+# ---------------------------------------------------------------------------
+# Per-rank skew
+# ---------------------------------------------------------------------------
+
+def rank_skew(records: Sequence[dict]) -> Dict:
+    """Worst-vs-median window time per matching step across merged rank
+    streams: `records` are merged event dicts
+    (:func:`igg.telemetry.merge_streams` output); every step at which
+    >= 2 ranks reported a ``step_stats`` window yields one row
+    ``{step, ranks, median_ms, worst_ms, worst_rank, skew_ms}``.
+    Returns ``{"per_step": [...], "max_skew_ms", "ranks"}`` and
+    publishes the maximum as the ``igg_rank_skew_ms`` gauge.  Window
+    times are per-rank durations, so host clock offsets (reported by
+    the merge tool's ``merge_summary``) cannot skew this number."""
+    by_step: Dict[int, Dict[int, float]] = {}
+    ranks = set()
+    for r in records:
+        if not isinstance(r, dict) or r.get("kind") != "step_stats":
+            continue
+        step = r.get("step")
+        payload = r.get("payload") or {}
+        ms = payload.get("ms_per_step")
+        if step is None or not isinstance(ms, (int, float)):
+            continue
+        p = int(r.get("process", 0))
+        ranks.add(p)
+        by_step.setdefault(int(step), {})[p] = float(ms)
+    per_step = []
+    max_skew = 0.0
+    for step in sorted(by_step):
+        window = by_step[step]
+        if len(window) < 2:
+            continue
+        vals = sorted(window.values())
+        k = len(vals)
+        median = (vals[k // 2] if k % 2
+                  else 0.5 * (vals[k // 2 - 1] + vals[k // 2]))
+        worst_rank = max(window, key=window.get)
+        worst = window[worst_rank]
+        skew = worst - median
+        max_skew = max(max_skew, skew)
+        per_step.append({"step": step, "ranks": len(window),
+                         "median_ms": median, "worst_ms": worst,
+                         "worst_rank": worst_rank, "skew_ms": skew})
+    if per_step:
+        _telemetry.gauge("igg_rank_skew_ms").set(max_skew)
+    return {"per_step": per_step, "max_skew_ms": max_skew,
+            "ranks": sorted(ranks)}
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m igg.comm report
+# ---------------------------------------------------------------------------
+
+def _report(inputs: Sequence[str], ledger: Optional[str], out) -> int:
+    from . import perf
+
+    # -- the comm section of the perf ledger --
+    entries: List[Dict] = []
+    if ledger is not None:
+        entries = [e for e in perf._read_ledger_file(ledger)
+                   if e.get("family") == "comm"]
+    else:
+        entries = perf.query("comm")
+    if entries:
+        out.write(f"# comm ledger ({len(entries)} entr"
+                  f"{'y' if len(entries) == 1 else 'ies'})\n")
+        out.write(perf._format_entries(entries))
+    else:
+        out.write("# comm ledger: no 'comm' entries"
+                  + (f" in {ledger}" if ledger else " in memory") + "\n")
+
+    if not inputs:
+        return 0
+    records = _telemetry.merge_streams(inputs)
+
+    # -- per-window decomposition table --
+    stats = [r for r in records if r.get("kind") == "comm_stats"]
+    out.write(f"\n# step-time decomposition ({len(stats)} window(s))\n")
+    if stats:
+        out.write(f"{'step':>8} {'rank':>4} {'source':>9} "
+                  f"{'compute_ms':>11} {'exchange_ms':>12} "
+                  f"{'hidden_ms':>10} {'exposed':>8} {'overlap_eff':>11}\n")
+        for r in stats:
+            p = r.get("payload") or {}
+            eff = p.get("overlap_efficiency")
+            out.write(
+                f"{str(r.get('step', '-')):>8} {r.get('process', 0):>4} "
+                f"{p.get('source', '-'):>9} "
+                f"{p.get('compute_ms', 0.0):>11.4f} "
+                f"{p.get('exchange_ms', 0.0):>12.4f} "
+                f"{p.get('hidden_ms', 0.0):>10.4f} "
+                f"{p.get('exposed_comm_fraction', 0.0):>8.3f} "
+                f"{('-' if eff is None else format(eff, '.3f')):>11}\n")
+
+    # -- per-rank skew --
+    skew = rank_skew(records)
+    out.write(f"\n# rank skew (worst-vs-median window time; "
+              f"{len(skew['ranks'])} rank(s))\n")
+    if skew["per_step"]:
+        out.write(f"{'step':>8} {'ranks':>5} {'median_ms':>10} "
+                  f"{'worst_ms':>9} {'worst_rank':>10} {'skew_ms':>8}\n")
+        for row in skew["per_step"]:
+            out.write(f"{row['step']:>8} {row['ranks']:>5} "
+                      f"{row['median_ms']:>10.4f} {row['worst_ms']:>9.4f} "
+                      f"{row['worst_rank']:>10} {row['skew_ms']:>8.4f}\n")
+        out.write(f"max skew: {skew['max_skew_ms']:.4f} ms\n")
+    else:
+        out.write("single-rank stream (or no matching-step windows): "
+                  "skew needs >= 2 ranks\n")
+
+    # -- stalls --
+    stalls = [r for r in records if r.get("kind") == "collective_stall"]
+    out.write(f"\n# collective stalls ({len(stalls)})\n")
+    for r in stalls:
+        p = r.get("payload") or {}
+        out.write(f"step {r.get('step')}: {p.get('in_flight')} not ready "
+                  f"after {p.get('age_s')}s (timeout {p.get('timeout_s')}s; "
+                  f"last completed step {p.get('last_completed_step')}, "
+                  f"{p.get('pending')} pending)\n")
+    return 0
+
+
+def _main(argv: Sequence[str]) -> int:
+    import sys
+
+    usage = ("usage: python -m igg.comm report [--ledger <ledger.json>] "
+             "[<events.jsonl|session-dir> ...]\n"
+             "  report  render the comm ledger, the per-window step-time\n"
+             "          decomposition, the per-rank skew table, and any\n"
+             "          collective-stall events from session artifacts")
+    argv = list(argv)
+    if not argv or argv[0] != "report":
+        print(usage, file=sys.stderr)
+        return 2
+    rest = argv[1:]
+    ledger = None
+    if "--ledger" in rest:
+        i = rest.index("--ledger")
+        if i + 1 >= len(rest):
+            print(usage, file=sys.stderr)
+            return 2
+        ledger = rest[i + 1]
+        del rest[i:i + 2]
+    try:
+        return _report(rest, ledger, sys.stdout)
+    except GridError as e:
+        print(f"igg.comm report: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":   # python -m igg.comm report ...
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

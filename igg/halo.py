@@ -493,6 +493,57 @@ def _pair_emulated(dtype) -> bool:
     return np.dtype(dtype).itemsize >= 8
 
 
+def plane_bytes_by_mode(local_shapes, dtypes, grid
+                        ) -> Dict[Tuple[str, str], int]:
+    """The `igg_halo_plane_bytes_total` accounting of one `update_halo`
+    call, broken down by ``(dim, mode)``: per field and per moving dim,
+    two boundary planes per device (each exchanged plane counted once),
+    summed over the mesh.  `mode` is ``{wire|local}_{grouped|stacked}``:
+
+    - *wire* — the dim is split across devices, so the planes ride the
+      collective (ICI links on a real slice); *local* — single-device
+      periodic self-wrap, pure HBM traffic;
+    - *stacked* — the field rides the pair-emulated lane-active group
+      program (`_stacked_lane64_update`: >= 2 same-shaped 8/16-byte
+      fields through ONE stacked block); *grouped* — every other engine
+      path (grouped pre-extracted, sequential per-dim, and the Pallas
+      writers — one collective per (dim, side) for same-shaped planes in
+      all of them).  The classification mirrors the engine's
+      stacked-group election on local shapes (pair-emulated fields never
+      take the writer path on hardware, so writer eligibility cannot
+      flip it).
+
+    Host arithmetic only — this is also the analytic model
+    :func:`igg.comm.plane_bytes_model` exposes, so counter deltas
+    reconcile against it exactly."""
+    import numpy as np
+
+    local_shapes = [tuple(s) for s in local_shapes]
+    movings = [moving_dims(active_dims(ls, grid), grid)
+               for ls in local_shapes]
+    stack_on = _is_tpu(grid) or _FORCE_STACKED64
+    groups: Dict[tuple, List[int]] = {}
+    for i, ls in enumerate(local_shapes):
+        if (stack_on and len(ls) == 3 and _pair_emulated(dtypes[i])
+                and any(d == len(ls) - 1 for d, _ in movings[i])):
+            key = (ls, str(np.dtype(dtypes[i])), tuple(movings[i]))
+            groups.setdefault(key, []).append(i)
+    stacked = {i for g in groups.values() if len(g) >= 2 for i in g}
+    out: Dict[Tuple[str, str], int] = {}
+    for i, ls in enumerate(local_shapes):
+        elems = 1
+        for v in ls:
+            elems *= int(v)
+        itemsize = np.dtype(dtypes[i]).itemsize
+        path = "stacked" if i in stacked else "grouped"
+        for d, _ in movings[i]:
+            transport = "wire" if grid.dims[d] > 1 else "local"
+            key = ("xyz"[d] if d < 3 else str(d), f"{transport}_{path}")
+            out[key] = out.get(key, 0) + (2 * (elems // int(ls[d]))
+                                          * itemsize * grid.nprocs)
+    return out
+
+
 def _materialize_planes(out, planes):
     """`optimization_barrier` fence between a block and the halo planes
     about to be written into it — the KEY unlock for pair-emulated dtypes
@@ -1213,17 +1264,18 @@ def update_halo(*fields, assembly=None):
     # summed over the mesh (the dim classification and plane sizes are
     # local-shape questions: `active_dims`/`ol_of_local` are defined on
     # per-device blocks, not the stacked global array).  Pure host
-    # arithmetic, counted once per call.
-    plane_bytes = 0
-    for A, ls in zip(fields, local_shapes):
-        elems = 1
-        for v in ls:
-            elems *= int(v)
-        itemsize = A.dtype.itemsize
-        for d, _ in moving_dims(active_dims(ls, grid), grid):
-            plane_bytes += (2 * (elems // int(ls[d])) * itemsize
-                            * grid.nprocs)
-    _telemetry.counter("igg_halo_plane_bytes_total").inc(plane_bytes)
+    # arithmetic, counted once per call.  The unlabeled total is kept
+    # for dashboard continuity; the (dim, mode) breakdown (wire vs
+    # local, grouped vs stacked — `plane_bytes_by_mode`) lets byte
+    # accounting reconcile against the analytic plane-bytes model per
+    # exchange path (igg.comm.plane_bytes_model is this same function).
+    by_mode = plane_bytes_by_mode(local_shapes,
+                                  [A.dtype for A in fields], grid)
+    _telemetry.counter("igg_halo_plane_bytes_total").inc(
+        sum(by_mode.values()))
+    for (dim, mode), nbytes in sorted(by_mode.items()):
+        _telemetry.counter("igg_halo_plane_bytes_total",
+                           dim=dim, mode=mode).inc(nbytes)
     try:
         if first and writer_possible:
             # Chaos seam (igg.chaos.kernel_compile_fail("halo.writer")).

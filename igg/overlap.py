@@ -65,6 +65,7 @@ from __future__ import annotations
 from typing import Callable
 
 from . import shared
+from . import telemetry as _telemetry
 from .halo import _plane, active_dims, assemble_field, exchange_all_dims
 from .shared import GridError
 
@@ -115,6 +116,26 @@ def hide_communication(A, compute: Callable, *aux, radius: int = 1,
                     f"send planes cannot be computed from in-block data "
                     f"(initialize the grid with a larger overlap).")
         per_field_dims.append(dims_f)
+
+    # Observability (igg.comm / igg.telemetry): hide_communication runs at
+    # TRACE time inside the caller's SPMD program, so per-call host
+    # accounting is impossible — instead every trace emits one
+    # `hide_communication` bus record + counter (which compiled programs
+    # carry the overlap restructuring), and the restructuring itself is a
+    # trace-time span, so its construction cost shows in the span trace.
+    _telemetry.counter("igg_hide_communication_traces_total").inc()
+    _telemetry.emit("hide_communication", n_fields=len(fields),
+                    radius=radius, dims=base_dims, assembly=assembly)
+    with _telemetry.span("overlap.hide_communication",
+                         n_fields=len(fields), radius=radius):
+        return _hide_impl(fields, aux, compute, radius, assembly, grid,
+                          single, s0, dims_base, per_field_dims)
+
+
+def _hide_impl(fields, aux, compute, radius, assembly, grid, single, s0,
+               dims_base, per_field_dims):
+    """The restructured step (see :func:`hide_communication`)."""
+    from jax import lax
 
     # 1. Send planes from thin slab computations (independent of the full
     #    compute).  All arrays are cut with a COMMON start `lo` along `d`

@@ -191,7 +191,19 @@ def _make_probe():
     return probe
 
 
+# Fault-injection seam (igg.chaos.collective_stall): a predicate applied
+# to every `is_ready` poll — the single readiness primitive the watchdog's
+# async probe fetches, the comm decomposition probes, and the stall
+# heartbeat all consult — so a hung collective (a probe that never
+# becomes ready) is injectable deterministically.  Host-level (consulted
+# at poll time, never traced), so arming needs no cache clearing.
+_CHAOS_FETCH_TAP = None
+
+
 def _is_ready(x) -> bool:
+    tap = _CHAOS_FETCH_TAP
+    if tap is not None and not tap(x):
+        return False
     try:
         return x.is_ready()
     except AttributeError:   # non-jax value: nothing pending
@@ -371,6 +383,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   install_sigterm: bool = True,
                   on_event: Optional[Callable[[Event], None]] = None,
                   telemetry=None,
+                  comm=None,
                   chaos=None) -> RunResult:
     """Drive `state = step_fn(state)` for `n_steps` steps with a device-side
     NaN/Inf watchdog, a rolling checkpoint ring, rollback-and-retry, and
@@ -422,6 +435,18 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
       piggybacked on the watchdog's async fetches (zero extra host
       syncs), exports metrics snapshots, and auto-dumps the flight
       recorder on `ResilienceError`/preemption/unhandled escapes.
+    - `comm`: an :class:`igg.comm.StepDecomposition` monitor — per-window
+      step-time decomposition probes (compute-only / compute+exchange /
+      hidden-overlap) dispatched at the watch cadence and observed through
+      the same non-blocking `is_ready` channel the watchdog uses (zero
+      additional host syncs; requires `watch_every` > 0; single-controller
+      only — warned off on multi-process runs).  Independently of `comm`,
+      every async probe fetch is registered with a collective-stall
+      heartbeat (`igg.comm.StallWatchdog`, `IGG_COMM_STALL_TIMEOUT`
+      seconds, default 120, 0 disables): a probe that never becomes ready
+      emits a `collective_stall` event, a `stall_r<rank>.json` report,
+      and a flight-recorder dump instead of hanging silently
+      (docs/observability.md, "Stall detection").
     - `chaos`: an :class:`igg.chaos.ChaosPlan` for deterministic fault
       injection (CI/testing only).
 
@@ -515,6 +540,37 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
               if watch and _perf.enabled() else None))
     m_steps = _telemetry.counter("igg_steps_total", run="resilient")
     m_rollbacks = _telemetry.counter("igg_rollbacks_total", run="resilient")
+
+    # Communication observability (igg.comm): the collective-stall
+    # heartbeat watches every async probe fetch (a hung collective then
+    # becomes a structured artifact instead of a silent hang), and an
+    # optional StepDecomposition monitor rides the watch cadence.
+    from . import comm as _comm
+
+    stall = (_comm.make_stall_watchdog("resilient")
+             if (watch and watch_every) else None)
+    comm_mon = None
+    if comm is not None:
+        if not (hasattr(comm, "maybe_dispatch") and hasattr(comm, "poll")):
+            raise GridError(
+                f"run_resilient: comm={comm!r}: expected an "
+                f"igg.comm.StepDecomposition monitor (or None).")
+        if not (watch and watch_every):
+            raise GridError(
+                "run_resilient: the comm= decomposition probes ride the "
+                "watch cadence; set watch_every > 0 (with watched "
+                "fields).")
+        if jax.process_count() > 1:
+            import warnings
+
+            warnings.warn(
+                "igg.run_resilient: comm= step-decomposition probes are "
+                "single-controller only (their dispatch cadence depends "
+                "on local readiness timing, which would desynchronize "
+                "multi-process collective streams); disabled for this "
+                "run.", stacklevel=2)
+        else:
+            comm_mon = comm
 
     steps_done = 0
     resumed_step = None
@@ -680,10 +736,14 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 return None
             pending.popleft()
             host = np.asarray(counts)
+            if stall is not None:
+                stall.fetched(("probe", step_p), step_p)
             bad = {n: int(c) for n, c in zip(watch, host) if c != 0}
             if bad:
                 # Younger pending probes are post-failure noise.
                 pending.clear()
+                if stall is not None:
+                    stall.clear()
                 return _emit("nan_detected", step_p, counts=bad)
             last_good = max(last_good, step_p)
             # Step stats piggyback on THIS fetch (igg.telemetry): the
@@ -691,6 +751,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             # telemetry costs a host timestamp — zero additional syncs.
             stats.fetched(step_p, steps_done)
         return None
+
+    def _dispatch_probe() -> None:
+        """One watchdog probe dispatch, registered with the stall
+        heartbeat (the in-flight record a hung collective is reported
+        against)."""
+        counts = probe(*[state[n] for n in watch])
+        pending.append((steps_done, counts))
+        if stall is not None:
+            stall.watch(("probe", steps_done), steps_done,
+                        "watchdog probe (psum over mesh axes)", counts)
 
     def _rollback(ev: Event) -> None:
         nonlocal state, steps_done, retries, step_fn, final_probe_done, \
@@ -763,6 +833,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 f"checkpoint generation exists under {cdir} to roll back "
                 f"to.", events)
         pending.clear()
+        if stall is not None:
+            stall.clear()
         m_rollbacks.inc()
         with _telemetry.span("resilience.rollback", step=ev.step,
                              target_step=target[0]):
@@ -862,8 +934,9 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 m_steps.inc(steps_per_call)
                 fail = None
                 if probe is not None and steps_done % watch_every == 0:
-                    pending.append(
-                        (steps_done, probe(*[state[n] for n in watch])))
+                    _dispatch_probe()
+                    if comm_mon is not None:
+                        comm_mon.maybe_dispatch(steps_done, stall)
                 if (divergence_fn is not None and watch_every
                         and steps_done % watch_every == 0
                         and divergence_fn(state)):
@@ -879,6 +952,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     # entry/rollback/preemption generations stay sync.
                     _save_gen(steps_done, sync=False)
                 _merge_writer()   # cheap: a deque pop, no blocking
+                if comm_mon is not None:
+                    comm_mon.poll(steps_done, stall)   # is_ready only
                 if tel is not None:
                     tel.maybe_export_metrics()   # one clock read when idle
             if preempted:
@@ -889,8 +964,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             if (probe is not None and not final_probe_done
                     and steps_done % watch_every != 0):
                 final_probe_done = True
-                pending.append(
-                    (steps_done, probe(*[state[n] for n in watch])))
+                _dispatch_probe()
             fail = _poll_probes(drain=True)
             if fail is None:
                 _merge_writer(drain=True)
@@ -903,8 +977,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             # (the rollback may raise — then the existing healthy
             # generations stand and the caller sees the real failure).
             if probe is not None and steps_done % watch_every != 0:
-                pending.append(
-                    (steps_done, probe(*[state[n] for n in watch])))
+                _dispatch_probe()
             fail = _poll_probes(drain=True)
             if fail is not None:
                 _rollback(fail)
@@ -943,6 +1016,13 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         _telemetry._auto_dump(f"run_resilient: {type(e).__name__}: {e}")
         raise
     finally:
+        if comm_mon is not None:
+            try:
+                comm_mon.finalize(steps_done)
+            except Exception:
+                pass   # a broken probe must not mask the run's outcome
+        if stall is not None:
+            stall.close()
         if writer is not None:
             try:
                 _merge_writer(drain=True)

@@ -721,6 +721,13 @@ class StepStats:
         self._anchor: Optional[Tuple[int, float]] = None
         self._sps = gauge("igg_steps_per_s", run=run)
         self._lag = gauge("igg_watchdog_fetch_lag_steps", run=run)
+        # The live straggler signal (igg.comm): every rank exports its
+        # window's per-step time (ms/step — windows of different lengths
+        # compare directly), rank identity carried by the per-rank
+        # metrics_r<rank>.prom file — a scraper diffing the exports across
+        # ranks sees the worst-vs-median skew live; `python -m igg.comm
+        # report` computes the same from merged streams (igg_rank_skew_ms).
+        self._win = gauge("igg_rank_window_ms", run=run)
         self._msps = (gauge("igg_member_steps_per_s") if members else None)
         self._perf_ctx = perf
         self._perf_state: Optional[dict] = None
@@ -745,6 +752,7 @@ class StepStats:
             return
         sps = dsteps / dt
         self._sps.set(sps)
+        self._win.set(1e3 / sps)
         payload = {"run": self.run, "steps_per_s": sps,
                    "ms_per_step": 1e3 / sps, "window_steps": dsteps,
                    "fetch_lag_steps": lag}
@@ -773,7 +781,11 @@ def merge_streams(inputs: Sequence, output=None) -> List[dict]:
     `output` is a path ('-' or None returns the records without
     writing).  Unparsable lines are skipped with a count in the trailing
     summary record rather than aborting the merge — a post-mortem must
-    survive a half-written line from a killed process."""
+    survive a half-written line from a killed process.  With records
+    from >= 2 ranks, the summary also estimates per-rank wall-clock
+    offsets (:func:`_rank_wall_offsets` — median pairwise delta on
+    matching-step records) so cross-rank timelines are not misread
+    through host clock drift."""
     files: List[pathlib.Path] = []
     for item in inputs:
         p = pathlib.Path(item)
@@ -801,11 +813,15 @@ def merge_streams(inputs: Sequence, output=None) -> List[dict]:
                 skipped += 1
     records.sort(key=lambda r: (r.get("wall", 0.0), r.get("process", 0),
                                 r.get("t", 0.0)))
-    if skipped:
+    offsets, matched = _rank_wall_offsets(records)
+    if skipped or offsets:
+        payload = {"skipped_lines": skipped,
+                   "files": [str(f) for f in files]}
+        if offsets:
+            payload["rank_wall_offsets"] = offsets
+            payload["offset_matched_records"] = matched
         records.append({"kind": "merge_summary", "process": -1,
-                        "wall": time.time(),
-                        "payload": {"skipped_lines": skipped,
-                                    "files": [str(f) for f in files]}})
+                        "wall": time.time(), "payload": payload})
     if output is not None and str(output) != "-":
         out = pathlib.Path(output)
         out.parent.mkdir(parents=True, exist_ok=True)
@@ -813,6 +829,48 @@ def merge_streams(inputs: Sequence, output=None) -> List[dict]:
             for r in records:
                 fh.write(json.dumps(r, default=str) + "\n")
     return records
+
+
+def _rank_wall_offsets(records: Sequence[dict]
+                       ) -> Tuple[Dict[str, float], int]:
+    """Per-rank wall-clock offset estimates vs the lowest-ranked process
+    (igg.comm, round 14): the MEDIAN pairwise wall delta over records
+    that match on (kind, step) — events both ranks anchor to the same
+    step (probe fetches, checkpoints, step stats) happen within one
+    watch window of each other, so the median over many matches
+    suppresses genuine per-window skew and leaves the host clock drift.
+    First occurrence per (kind, step, process) only: a rolled-back
+    replay re-emits the same steps, and its later copies are not
+    simultaneous with the other rank's first pass.  Returns
+    ``({rank: offset_seconds}, matched_record_count)`` — empty when
+    fewer than two ranks share any step-anchored records.  Reported in
+    the merge tool's ``merge_summary`` so cross-rank timelines are not
+    misread through clock drift."""
+    first: Dict[Tuple, Dict[int, float]] = {}
+    for r in records:
+        if not isinstance(r, dict) or r.get("step") is None:
+            continue
+        kind = r.get("kind")
+        if not kind or kind == "merge_summary":
+            continue
+        p = int(r.get("process", 0))
+        by_proc = first.setdefault((kind, r["step"]), {})
+        if p not in by_proc:
+            by_proc[p] = float(r.get("wall", 0.0) or 0.0)
+    ranks = sorted({p for by in first.values() for p in by})
+    if len(ranks) < 2:
+        return {}, 0
+    ref = ranks[0]
+    offsets: Dict[str, float] = {}
+    matched = 0
+    for p in ranks[1:]:
+        deltas = sorted(by[p] - by[ref] for by in first.values()
+                        if ref in by and p in by)
+        if not deltas:
+            continue
+        matched += len(deltas)
+        offsets[str(p)] = deltas[len(deltas) // 2]
+    return offsets, matched
 
 
 def _records_from_dicts(dicts: Sequence[dict]) -> List[Record]:

@@ -1,0 +1,499 @@
+"""Communication observability (igg/comm.py) and its round-14
+satellites: the (dim, mode)-labeled plane-bytes counters reconciling
+against the analytic model, the comm ledger + ICI roofline gauges, the
+step-time decomposition (AOT and in-run), the collective-stall
+heartbeat fired deterministically through the chaos probe-fetch seam,
+per-rank skew + merge-tool clock offsets, hide_communication
+span/metric wiring, and the `python -m igg.comm report` CLI."""
+
+import json
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+import igg
+from igg import comm as icomm
+from igg import telemetry as tel
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Metrics, the flight ring, and the perf ledger are process-global;
+    isolate every test (the test_telemetry fixture's pattern)."""
+    tel.reset_metrics()
+    tel._ring().clear()
+    igg.perf.reset()
+    yield
+    for s in list(tel._SESSIONS):
+        s.detach()
+    tel.reset_metrics()
+    igg.perf.reset()
+
+
+def _grid(**kw):
+    args = dict(periodx=1, periody=1, periodz=1, quiet=True)
+    args.update(kw)
+    igg.init_global_grid(6, 6, 6, **args)
+
+
+def _compute(T):
+    from igg.ops import interior_add
+
+    lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+           + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+           + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+           - 6.0 * T[1:-1, 1:-1, 1:-1])
+    return interior_add(T, 0.1 * lap)
+
+
+def _make_step():
+    @igg.sharded
+    def step(T):
+        return igg.update_halo_local(_compute(T))
+
+    return lambda st: {"T": step(st["T"])}
+
+
+def _init_state(seed=3):
+    rng = np.random.default_rng(seed)
+    T = igg.from_local_blocks(lambda c, ls: rng.standard_normal(ls),
+                              (6, 6, 6))
+    return {"T": igg.update_halo(T)}
+
+
+def _counter_value(name_key):
+    return tel.snapshot().get(name_key, {}).get("value", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# (i) labeled plane-bytes counters + the analytic model
+# ---------------------------------------------------------------------------
+
+def test_plane_bytes_counter_reconciles_against_model():
+    """One grouped update_halo advances the unlabeled total by exactly
+    the analytic model, and the (dim, mode) breakdown sums to it —
+    wire mode on the fully-split (2,2,2) mesh."""
+    _grid()
+    T = igg.zeros((6, 6, 6), dtype=np.float32) + 1.0
+    before = _counter_value("igg_halo_plane_bytes_total")
+    T = igg.update_halo(T)
+    delta = _counter_value("igg_halo_plane_bytes_total") - before
+    total, by_mode = icomm.plane_bytes_model((6, 6, 6), np.float32)
+    assert delta == total > 0
+    assert set(by_mode) == {("x", "wire_grouped"), ("y", "wire_grouped"),
+                            ("z", "wire_grouped")}
+    labeled = sum(
+        _counter_value(f'igg_halo_plane_bytes_total{{dim="{d}",'
+                       f'mode="{m}"}}') for d, m in by_mode)
+    assert labeled == total
+
+
+def test_plane_bytes_local_mode_on_unsplit_periodic_dim():
+    """A single-device periodic dim is a self-wrap copy — mode 'local',
+    not 'wire' — and the unlabeled total still counts it (dashboard
+    continuity)."""
+    _grid(dimx=4, dimy=2, dimz=1)
+    total, by_mode = icomm.plane_bytes_model((6, 6, 6), np.float32)
+    assert by_mode[("z", "local_grouped")] > 0
+    assert by_mode[("x", "wire_grouped")] > 0
+    T = igg.zeros((6, 6, 6)) + 1.0
+    before = _counter_value("igg_halo_plane_bytes_total")
+    igg.update_halo(T)
+    assert (_counter_value("igg_halo_plane_bytes_total") - before
+            == total)
+    assert _counter_value('igg_halo_plane_bytes_total{dim="z",'
+                          'mode="local_grouped"}') == \
+        by_mode[("z", "local_grouped")]
+
+
+def test_plane_bytes_stacked_mode_classification():
+    """>= 2 same-shaped lane-active pair-emulated fields classify as the
+    stacked group program (the `_stacked_lane64_update` election,
+    engaged on CPU via the `_FORCE_STACKED64` seam) — and a single f64
+    field stays 'grouped'."""
+    from igg import halo
+
+    _grid()
+    grid = igg.get_global_grid()
+    halo._FORCE_STACKED64 = True
+    try:
+        by2 = halo.plane_bytes_by_mode([(6, 6, 6)] * 2,
+                                       [np.float64] * 2, grid)
+        assert set(m for _, m in by2) == {"wire_stacked"}
+        by1 = halo.plane_bytes_by_mode([(6, 6, 6)], [np.float64], grid)
+        assert set(m for _, m in by1) == {"wire_grouped"}
+        # The counters agree with the engine actually running the
+        # stacked program.
+        A = igg.zeros((6, 6, 6), dtype=np.float64) + 1.0
+        B = igg.zeros((6, 6, 6), dtype=np.float64) + 2.0
+        before = _counter_value('igg_halo_plane_bytes_total{dim="x",'
+                                'mode="wire_stacked"}')
+        A, B = igg.update_halo(A, B)
+        assert _counter_value('igg_halo_plane_bytes_total{dim="x",'
+                              'mode="wire_stacked"}') > before
+        assert np.isfinite(np.asarray(A)).all()
+    finally:
+        halo._FORCE_STACKED64 = False
+        halo.free_update_halo_buffers()
+
+
+# ---------------------------------------------------------------------------
+# (ii) the comm ledger + ICI roofline gauges
+# ---------------------------------------------------------------------------
+
+def test_calibrate_comm_records_ledger_sample_and_gauges(tmp_path,
+                                                         monkeypatch):
+    _grid()
+    monkeypatch.setenv("IGG_PERF_LEDGER", str(tmp_path / "ledger.json"))
+    sample = icomm.calibrate_comm(nfields=2, n_inner=2, nt=2)
+    assert sample["path"] == "grouped"
+    assert sample["tier"] == "halo.xyz.grouped"
+    assert sample["gbps"] > 0
+    # CPU mesh: the ICI link peak is honestly None — no pct gauge lies.
+    assert sample["link_peak_gbps"] is None
+    assert sample["pct_link_peak"] is None
+    snap = tel.snapshot()
+    assert snap['igg_halo_gbps{path="grouped"}']["value"] == \
+        pytest.approx(sample["gbps"])
+    assert not any(k.startswith("igg_pct_link_peak") for k in snap)
+    # The ledger's comm section: keyed on (dims, dtype, shape, path,
+    # backend, device_kind), persisted through the PR-8 machinery.
+    entries = igg.perf.query("comm")
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["tier"] == "halo.xyz.grouped"
+    assert tuple(e["dims"]) == (2, 2, 2)
+    assert e["backend"] == "cpu"
+    assert igg.perf.save() is not None
+    doc = json.loads((tmp_path / "ledger.json").read_text())
+    assert any(v["family"] == "comm" for v in doc["entries"].values())
+    # A comm_sample bus record landed in the flight ring.
+    assert any(r.kind == "comm_sample" for r in tel.flight_recorder())
+
+
+def test_link_peak_table_is_honest():
+    assert icomm.link_peak_gbps("TPU v5p") == 600.0
+    assert icomm.link_peak_gbps("TPU v5e") == 200.0
+    assert icomm.link_peak_gbps("cpu") is None          # no invented peak
+    assert icomm.link_peak_gbps("TPU v99x") is None     # unknown chip
+    assert icomm.link_peak_gbps(None) is None
+
+
+def test_calibrate_comm_returns_none_when_nothing_moves():
+    """A single open-boundary device: no dim moves (both global edges
+    live on the one device), so there is nothing to measure — None, not
+    a zero-byte sample."""
+    import jax
+
+    igg.init_global_grid(6, 6, 6, periodx=0, periody=0, periodz=0,
+                         quiet=True, devices=jax.devices()[:1])
+    assert icomm.calibrate_comm(nfields=1, n_inner=2, nt=2) is None
+    assert igg.perf.query("comm") == []
+
+
+# ---------------------------------------------------------------------------
+# (iii) step-time decomposition
+# ---------------------------------------------------------------------------
+
+def test_decompose_emits_comm_stats_and_fractions():
+    _grid()
+    state = _init_state()
+    d = icomm.decompose(_compute, (state["T"],), nt=2, n_inner=3)
+    assert d["compute_ms"] > 0 and d["exchange_ms"] > 0
+    assert 0.0 <= d["exposed_comm_fraction"] <= 1.0
+    if "overlap_efficiency" in d:
+        assert 0.0 <= d["overlap_efficiency"] <= 1.0
+    recs = [r for r in tel.flight_recorder() if r.kind == "comm_stats"]
+    assert recs and recs[-1].payload["source"] == "calibrate"
+    # The decomposition also lands in the comm ledger (overlap.* tiers).
+    tiers = {e["tier"] for e in igg.perf.query("comm")}
+    assert {"overlap.compute", "overlap.exchange",
+            "overlap.hidden"} <= tiers
+
+
+def test_step_decomposition_monitor_rides_run_resilient(tmp_path):
+    _grid()
+    state = _init_state()
+    monitor = icomm.StepDecomposition(_compute, (state["T"],), reps=2)
+    res = igg.run_resilient(_make_step(), state, 120, watch_every=2,
+                            telemetry=tmp_path, comm=monitor,
+                            install_sigterm=False)
+    assert res.steps_done == 120
+    assert monitor.windows >= 1
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    stats = [r for r in recs if r["kind"] == "comm_stats"]
+    assert len(stats) == monitor.windows
+    for r in stats:
+        p = r["payload"]
+        assert p["source"] == "probe"
+        assert 0.0 <= p["exposed_comm_fraction"] <= 1.0
+        assert p["compute_ms"] > 0 and p["hidden_ms"] > 0
+    snap = tel.snapshot()
+    assert 'igg_exposed_comm_fraction{run="resilient"}' in snap
+
+
+def test_comm_monitor_requires_watch_cadence():
+    _grid()
+    state = _init_state()
+    monitor = icomm.StepDecomposition(_compute, (state["T"],), reps=2)
+    with pytest.raises(igg.GridError, match="watch cadence"):
+        igg.run_resilient(_make_step(), state, 4, watch_every=0,
+                          comm=monitor, install_sigterm=False)
+    with pytest.raises(igg.GridError, match="StepDecomposition"):
+        igg.run_resilient(_make_step(), state, 4, watch_every=2,
+                          comm="not-a-monitor", install_sigterm=False)
+
+
+# ---------------------------------------------------------------------------
+# (iv) the collective-stall heartbeat
+# ---------------------------------------------------------------------------
+
+def test_stall_watchdog_fires_deterministically_via_chaos(tmp_path,
+                                                          monkeypatch):
+    """The acceptance path: chaos-injected never-ready fetches through
+    the probe-fetch seam -> the heartbeat reports the over-age in-flight
+    probe as a `collective_stall` event + structured stall report +
+    flight dump, and the run still completes (forced fetches retire the
+    probes — only the readiness channel is stalled)."""
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0.05")
+    _grid()
+    state = _init_state()
+    step_fn = _make_step()
+    slow = lambda st: (time.sleep(0.004), step_fn(st))[1]
+    with igg.chaos.collective_stall():
+        res = igg.run_resilient(slow, state, 40, watch_every=5,
+                                max_pending_probes=100,
+                                telemetry=tmp_path, install_sigterm=False)
+    assert res.steps_done == 40
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    stalls = [r for r in recs if r["kind"] == "collective_stall"]
+    assert len(stalls) == 1          # once per stall episode, not per probe
+    p = stalls[0]["payload"]
+    assert "watchdog probe" in p["in_flight"]
+    assert p["age_s"] >= 0.05 and p["timeout_s"] == 0.05
+    assert p["pending"] >= 1
+    report = json.loads((tmp_path / "stall_r0.json").read_text())
+    assert report["reason"] == "collective_stall"
+    assert report["step"] == stalls[0]["step"]
+    dump = json.loads((tmp_path / "flight_r0.json").read_text())
+    assert "collective_stall" in dump["reason"]
+    assert any(r["kind"] == "collective_stall" for r in dump["events"])
+
+
+def test_stall_watchdog_quiet_on_healthy_run(tmp_path, monkeypatch):
+    """Default-on stall detection must be silent on a healthy run (and a
+    ready-but-unfetched probe is a slow host, not a stall).  The timeout
+    sits above any plausible CI-host window so the only way to fire is a
+    genuine freeze."""
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "30")
+    _grid()
+    res = igg.run_resilient(_make_step(), _init_state(), 30,
+                            watch_every=5, telemetry=tmp_path,
+                            install_sigterm=False)
+    assert res.steps_done == 30
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    assert not any(r["kind"] == "collective_stall" for r in recs)
+    assert not (tmp_path / "stall_r0.json").exists()
+
+
+def test_stall_watchdog_unit_check_and_heal():
+    """Unit-level: an over-age not-ready entry fires once; a subsequent
+    fetch re-arms; timeout <= 0 disables via the factory."""
+
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+    sw = icomm.StallWatchdog(0.01, run="unit", poll_s=10.0)  # no thread race
+    try:
+        sw.watch("a", 5, "unit probe", NeverReady())
+        assert not sw.check(now=time.monotonic())   # not over-age yet
+        time.sleep(0.02)
+        assert sw.check()                           # fires
+        assert sw.stalls == 1
+        assert not sw.check()                       # once per episode
+        sw.fetched("a", 5)                          # heals
+        sw.watch("b", 7, "unit probe", NeverReady())
+        time.sleep(0.02)
+        assert sw.check() and sw.stalls == 2
+        sw.fetched("b", 7)
+        # Ready-but-unfetched is not a stall.
+        sw.watch("c", 9, "unit probe", np.float32(1.0))
+        time.sleep(0.02)
+        assert not sw.check()
+    finally:
+        sw.close()
+    assert icomm.make_stall_watchdog("x") is not None      # default on
+
+
+def test_make_stall_watchdog_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0")
+    assert icomm.make_stall_watchdog("x") is None
+
+
+def test_collective_stall_seam_restores_on_exit():
+    from igg import resilience
+
+    class Obj:
+        def is_ready(self):
+            return True
+
+    assert resilience._is_ready(Obj())
+    with igg.chaos.collective_stall():
+        assert resilience._CHAOS_FETCH_TAP is not None
+        assert not resilience._is_ready(Obj())
+    assert resilience._CHAOS_FETCH_TAP is None
+    assert resilience._is_ready(Obj())
+
+
+# ---------------------------------------------------------------------------
+# (v) per-rank skew + merge-tool clock offsets
+# ---------------------------------------------------------------------------
+
+def _fake_rank_stream(path, process, rows):
+    """rows: (wall, kind, step, payload)"""
+    with open(path, "w") as fh:
+        for wall, kind, step, payload in rows:
+            fh.write(json.dumps({"t": wall, "wall": wall,
+                                 "process": process, "kind": kind,
+                                 "step": step, "payload": payload}) + "\n")
+
+
+def test_rank_skew_worst_vs_median(tmp_path):
+    for p, ms in ((0, 10.0), (1, 16.0), (2, 11.0)):
+        _fake_rank_stream(
+            tmp_path / f"events_r{p}.jsonl", p,
+            [(100.0 + p, "step_stats", 50,
+              {"ms_per_step": ms, "steps_per_s": 1e3 / ms}),
+             (200.0 + p, "step_stats", 100,
+              {"ms_per_step": ms + 1, "steps_per_s": 1e3 / (ms + 1)})])
+    merged = tel.merge_streams([tmp_path])
+    skew = icomm.rank_skew(merged)
+    assert skew["ranks"] == [0, 1, 2]
+    assert len(skew["per_step"]) == 2
+    row = skew["per_step"][0]
+    assert row["worst_rank"] == 1
+    assert row["median_ms"] == 11.0
+    assert row["skew_ms"] == pytest.approx(5.0)
+    assert skew["max_skew_ms"] == pytest.approx(5.0)
+    assert tel.snapshot()["igg_rank_skew_ms"]["value"] == \
+        pytest.approx(5.0)
+    # Single-rank streams: no skew, no crash.
+    solo = [r for r in merged if r.get("process") == 0]
+    assert icomm.rank_skew(solo)["per_step"] == []
+
+
+def test_merge_summary_reports_rank_wall_offsets(tmp_path):
+    """Rank 1's clock runs 5 s ahead: the merge summary's offset
+    estimate recovers it as the median pairwise delta on matching-step
+    records."""
+    _fake_rank_stream(tmp_path / "events_r0.jsonl", 0,
+                      [(100.0, "checkpoint", 10, {}),
+                       (200.0, "checkpoint", 20, {}),
+                       (300.0, "step_stats", 30, {"ms_per_step": 1.0})])
+    _fake_rank_stream(tmp_path / "events_r1.jsonl", 1,
+                      [(105.2, "checkpoint", 10, {}),
+                       (204.9, "checkpoint", 20, {}),
+                       (305.0, "step_stats", 30, {"ms_per_step": 2.0})])
+    merged = tel.merge_streams([tmp_path])
+    summary = merged[-1]
+    assert summary["kind"] == "merge_summary"
+    offs = summary["payload"]["rank_wall_offsets"]
+    assert offs["1"] == pytest.approx(5.0, abs=0.3)
+    assert summary["payload"]["offset_matched_records"] == 3
+    # Single-rank merge: no offsets, and (with no skipped lines) no
+    # summary record at all — the round-12 contract unchanged.
+    solo = tel.merge_streams([tmp_path / "events_r0.jsonl"])
+    assert all(r["kind"] != "merge_summary" for r in solo)
+
+
+def test_step_stats_sets_rank_window_gauge():
+    stats = tel.StepStats("unit")
+    stats.fetched(10, 10)
+    time.sleep(0.002)
+    stats.fetched(20, 20)
+    snap = tel.snapshot()
+    assert snap['igg_rank_window_ms{run="unit"}']["value"] > 0
+
+
+# ---------------------------------------------------------------------------
+# (vi) hide_communication span/metric wiring
+# ---------------------------------------------------------------------------
+
+def test_hide_communication_telemetry_wiring():
+    """Tracing a hide_communication program emits the bus record, the
+    trace counter, and a span — and the restructured step still matches
+    the plain composition on the 8-device interpret mesh."""
+    _grid()
+    state = _init_state()
+
+    @igg.sharded
+    def hidden_step(T):
+        return igg.hide_communication(T, _compute)
+
+    before = _counter_value("igg_hide_communication_traces_total")
+    out = hidden_step(state["T"])
+    assert (_counter_value("igg_hide_communication_traces_total")
+            - before) >= 1
+    recs = [r for r in tel.flight_recorder()
+            if r.kind == "hide_communication"]
+    assert recs and recs[-1].payload["n_fields"] == 1
+    assert recs[-1].payload["radius"] == 1
+    assert recs[-1].payload["dims"] == [0, 1, 2]
+    spans = [r for r in tel.flight_recorder() if r.kind == "span"
+             and r.payload.get("name") == "overlap.hide_communication"]
+    assert spans
+
+    @igg.sharded
+    def plain_step(T):
+        return igg.update_halo_local(_compute(T))
+
+    # Numerical, not bitwise: the slab and full-domain programs may
+    # fuse/FMA-contract differently (the test_overlap contract).
+    ref = plain_step(state["T"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# (vii) the report CLI
+# ---------------------------------------------------------------------------
+
+def test_report_cli_renders_ledger_decomposition_skew_and_stalls(
+        tmp_path, capsys):
+    _grid()
+    icomm.calibrate_comm(nfields=1, n_inner=2, nt=2)
+    rows = [(100.0, "step_stats", 50, {"ms_per_step": 10.0}),
+            (150.0, "comm_stats", 60,
+             {"source": "probe", "compute_ms": 1.0, "exchange_ms": 2.0,
+              "hidden_ms": 1.5, "exposed_comm_fraction": 0.5,
+              "overlap_efficiency": 0.5}),
+            (180.0, "collective_stall", 70,
+             {"in_flight": "watchdog probe", "age_s": 1.2,
+              "timeout_s": 1.0, "last_completed_step": 65,
+              "pending": 2})]
+    _fake_rank_stream(tmp_path / "events_r0.jsonl", 0, rows)
+    _fake_rank_stream(tmp_path / "events_r1.jsonl", 1,
+                      [(100.5, "step_stats", 50, {"ms_per_step": 14.0})])
+    rc = icomm._main(["report", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "comm ledger" in out and "halo.xyz.grouped" in out
+    assert "step-time decomposition" in out and "0.500" in out
+    # Two ranks at step 50 (10 vs 14 ms): even-count median 12, skew 2.
+    assert "rank skew" in out and "max skew: 2.0000 ms" in out
+    assert "collective stalls (1)" in out and "watchdog probe" in out
+    # Usage errors exit 2.
+    assert icomm._main([]) == 2
+    assert icomm._main(["report", "--ledger"]) == 2
+
+
+def test_comm_env_knob_registered():
+    from igg import _env
+
+    assert "IGG_COMM_STALL_TIMEOUT" in _env._KNOWN

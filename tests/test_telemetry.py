@@ -362,10 +362,11 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
             fetches.append(type(x).__name__)
         return real_asarray(x, *a, **kw)
 
-    def run(telemetry):
+    def run(telemetry, comm=None):
         fetches.clear()
         igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
-                          telemetry=telemetry, install_sigterm=False)
+                          telemetry=telemetry, comm=comm,
+                          install_sigterm=False)
         return len(fetches)
 
     monkeypatch.setattr(res_mod, "np", type(np)("np_proxy"))
@@ -388,6 +389,28 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
                        str(tmp_path / "perf" / "ledger.json"))
     with_perf = run(telemetry=tmp_path / "session2")
     assert with_perf == bare
+    # Round 14: with COMM observability enabled too — the stall
+    # heartbeat watching every probe and a StepDecomposition monitor
+    # dispatching its variant probes at the watch cadence — the
+    # decomposition is observed entirely through is_ready polling
+    # (never materialized), so the device-array fetch counts are STILL
+    # identical.
+    from igg import comm as icomm
+
+    def compute(T):
+        from igg.ops import interior_add
+
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return interior_add(T, 0.1 * lap)
+
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "60")
+    monitor = icomm.StepDecomposition(compute, (_init_state()["T"],),
+                                      reps=2)
+    with_comm = run(telemetry=tmp_path / "session3", comm=monitor)
+    assert with_comm == bare
 
 
 # ---------------------------------------------------------------------------
