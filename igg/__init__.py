@@ -43,7 +43,11 @@ regression gate), and the communication-observability layer (`igg.comm`
 — the comm ledger + ICI roofline gauges, per-window step-time
 decomposition with exposed-comm fraction and overlap efficiency,
 per-rank skew, and the collective-stall heartbeat that turns hung
-collectives into structured artifacts).
+collectives into structured artifacts), and the self-healing control
+plane (`igg.heal` — a policy engine subscribed to the event bus that
+closes the detection→action loops: stall/straggler → elastic re-tile,
+cost-model drift → re-calibration, lagging fleet job → repack, all
+budget/hysteresis-governed and chaos-proven).
 """
 
 from ._compat import install as _compat_install
@@ -111,6 +115,7 @@ from . import degrade
 from . import device
 from . import ensemble
 from . import fleet
+from . import heal
 from . import perf
 from . import profiling
 from . import resilience
@@ -140,6 +145,6 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
-    "telemetry", "Telemetry", "perf", "comm",
+    "telemetry", "Telemetry", "perf", "comm", "heal",
     "time_steps", "__version__",
 ]

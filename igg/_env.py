@@ -46,6 +46,25 @@ _KNOWN: Dict[str, str] = {
         "initial sleep between fleet job-launch retries (s, doubling)",
     "IGG_FLEET_RETRIES":
         "launcher-fault retries per fleet job before it is marked failed",
+    "IGG_HEAL":
+        "1 enables the igg.heal self-healing engine on every run loop "
+        "(default off; heal= on the run loops overrides)",
+    "IGG_HEAL_COOLDOWN":
+        "minimum seconds between consecutive heal actions (hysteresis; "
+        "default 60)",
+    "IGG_HEAL_MAX_ACTIONS":
+        "heal-action budget per run before the escalation ladder "
+        "(default 3)",
+    "IGG_HEAL_SKEW_TOL":
+        "straggler threshold: a watchdog window (or rank skew) beyond "
+        "this factor of the healthy baseline plans a re-tile "
+        "(default 4.0)",
+    "IGG_HEAL_SUSTAIN":
+        "consecutive observations a soft heal signal must persist "
+        "before an action is planned (default 2)",
+    "IGG_HEAL_THROUGHPUT_TOL":
+        "lagging-job threshold: measured member_steps_per_s below this "
+        "fraction of the expectation plans a repack (default 0.5)",
     "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
     "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
     "IGG_PERF": "0 disables perf-ledger recording (igg.perf)",

@@ -49,6 +49,15 @@ one-shot by default so a rolled-back replay does not re-fail:
   journal-persist + elastic-resume path).  :class:`ChaosPlan` itself
   grew member-targeted `nan_at` entries `(step, member, field)` for the
   per-member isolation paths of :mod:`igg.ensemble`.
+- the :mod:`igg.heal` fault set (round 15), through the SAME two seams:
+  :func:`collective_stall` gained `device=` (the stall persists only
+  while that chip is in the live grid, so a heal re-tile that fences it
+  heals the fault — zero test intervention), :func:`straggler` rate-
+  limits probe readiness so measured watchdog windows inflate like a
+  slow rank's, :func:`throughput_collapse` collapses one fleet job's
+  measured member rate for one launch (consumed one-shot at the job
+  tap), and :func:`stale_calibration` installs a wrong cost-model
+  prediction so the next measured sample fires `cost_model_drift`.
 
 Prefer the exception-safe context managers — every injector supports
 ``with`` directly, and :func:`armed` composes several — so a test failure
@@ -75,6 +84,8 @@ from .shared import GridError
 __all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
            "HaloCorruption", "kernel_compile_fail", "kernel_corrupt",
            "KernelChaos", "collective_stall", "FetchStall",
+           "straggler", "FetchDelay", "throughput_collapse",
+           "stale_calibration", "StaleCalibration",
            "scheduler_fault", "job_preempt_at", "JobChaos",
            "InjectedSchedulerFault", "armed"]
 
@@ -409,12 +420,40 @@ class FetchStall:
     clearing; forced fetches (`np.asarray` at the pending-depth bound or
     the end-of-run drain) still complete, because the underlying data IS
     ready — only the readiness channel is stalled, which is exactly the
-    shape of a hung collective as the host observes it."""
+    shape of a hung collective as the host observes it.
+
+    With `device` (a jax device, or its index into `jax.devices()`),
+    the stall is TIED TO THE CHIP: polls report not-ready only while
+    that device participates in the live grid — the sick-chip shape the
+    :mod:`igg.heal` elastic re-tile fences.  Once a heal action
+    re-initializes the grid without the device, the fault is gone with
+    zero test/operator intervention, exactly like fencing real broken
+    hardware."""
+
+    def __init__(self, device=None):
+        self._device = device
+
+    def _sick_in_grid(self) -> bool:
+        from . import shared
+
+        if not shared.grid_is_initialized():
+            return True            # no grid to have fenced it yet
+        dev = self._device
+        if isinstance(dev, (int, np.integer)):
+            import jax
+
+            dev = jax.devices()[int(dev)]
+        return dev in list(shared.global_grid().mesh.devices.flat)
+
+    def _tap(self, obj) -> bool:
+        if self._device is None:
+            return False           # unconditionally stalled
+        return not self._sick_in_grid()
 
     def arm(self) -> "FetchStall":
         from . import resilience
 
-        resilience._CHAOS_FETCH_TAP = lambda obj: False
+        resilience._CHAOS_FETCH_TAP = self._tap
         return self
 
     def disarm(self) -> None:
@@ -429,7 +468,7 @@ class FetchStall:
         self.disarm()
 
 
-def collective_stall() -> FetchStall:
+def collective_stall(device=None) -> FetchStall:
     """Context manager making every async probe fetch report not-ready —
     the deterministic stand-in for a collective hung on the interconnect
     (a device that never completes the psum).  The stall heartbeat
@@ -444,8 +483,154 @@ def collective_stall() -> FetchStall:
 
     `max_pending_probes` is raised in the demonstration so the loop's
     forced fetches don't retire the probe before the deadline expires;
-    the run still completes (the end-of-run drain force-fetches)."""
-    return FetchStall()
+    the run still completes (the end-of-run drain force-fetches).
+
+    `device` ties the stall to one chip (:class:`FetchStall`): the hang
+    persists only while that device is part of the live grid, so an
+    :mod:`igg.heal` re-tile that fences it HEALS the fault — the
+    sick-chip shape the stall→re-tile control loop is chaos-proven
+    against (`tests/test_heal.py`)."""
+    return FetchStall(device=device)
+
+
+class FetchDelay:
+    """Armed straggler injection (see :func:`straggler`): a RATE LIMIT on
+    the probe-fetch readiness channel — at most one readiness grant per
+    `delay_s` seconds (after `after` free grants establishing the
+    healthy baseline), through the same
+    `igg.resilience._CHAOS_FETCH_TAP` seam as :class:`FetchStall`.
+    Completion events then trickle at the slow rank's pace, inflating
+    every watchdog window the :class:`igg.telemetry.StepStats` meter
+    measures — the straggler shape as the host observes it.  Forced
+    fetches still complete (the data IS ready), so the run always
+    finishes; raise `max_pending_probes` so measured windows stay
+    readiness-gated."""
+
+    def __init__(self, delay_s: float, *, rank: Optional[int] = None,
+                 after: int = 0):
+        self._delay = float(delay_s)
+        self._rank = rank
+        self._free = int(after)
+        self._last_grant: Optional[float] = None
+
+    def _tap(self, obj) -> bool:
+        import time
+
+        if self._rank is not None:
+            import jax
+
+            if int(jax.process_index()) != int(self._rank):
+                return True
+        now = time.monotonic()
+        if self._free > 0:
+            self._free -= 1
+            self._last_grant = now
+            return True
+        if self._last_grant is None or now - self._last_grant >= self._delay:
+            self._last_grant = now
+            return True
+        return False
+
+    def arm(self) -> "FetchDelay":
+        from . import resilience
+
+        resilience._CHAOS_FETCH_TAP = self._tap
+        return self
+
+    def disarm(self) -> None:
+        from . import resilience
+
+        resilience._CHAOS_FETCH_TAP = None
+
+    def __enter__(self) -> "FetchDelay":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def straggler(rank: int = 0, factor: float = 4.0, *,
+              base_window_s: float = 0.05, after: int = 0) -> FetchDelay:
+    """Context manager making controller `rank` a STRAGGLER: probe
+    readiness grants are rate-limited to one per
+    ``factor × base_window_s`` seconds (`base_window_s` approximates the
+    healthy watch window), so measured watchdog windows inflate by
+    ~`factor` — the slow-rank shape the :mod:`igg.heal` straggler →
+    elastic re-tile loop detects against its healthy baseline.  `after`
+    grants pass unrestricted first, so the run establishes that baseline
+    before the slowdown strikes (a chip degrading mid-run, not a
+    misconfigured one).  Rides the probe-fetch seam
+    (:class:`FetchDelay`); single-process runs are rank 0."""
+    return FetchDelay(factor * base_window_s, rank=rank, after=after)
+
+
+def throughput_collapse(job: str, *, delay_s: float = 0.25) -> JobChaos:
+    """Context manager collapsing fleet job `job`'s measured throughput:
+    consumed ONE-SHOT at the job's launch (the `_CHAOS_JOB_TAP` seam),
+    the scheduler arms a :class:`FetchDelay` rate limit of one probe
+    grant per `delay_s` for that launch only — measured
+    ``member_steps_per_s`` collapses while the simulation itself stays
+    healthy, the lagging-job shape the :mod:`igg.heal` repack loop
+    preempts and re-admits at a different member packing.  The re-launch
+    runs clean (the tap was consumed), which is what makes
+    repack-and-finish provable bit-exactly.  Raise
+    ``IGG_ENSEMBLE_MAX_PENDING_PROBES`` so the collapsed windows stay
+    readiness-gated rather than force-fetched."""
+    return JobChaos("collapse", job, {"delay_s": float(delay_s)})
+
+
+class StaleCalibration:
+    """Armed stale-calibration injection (see :func:`stale_calibration`):
+    registers a bogus cost-model prediction for a family on `arm()` and
+    restores the previous registration on `disarm()` — the
+    fault is a calibration that no longer matches the hardware, so the
+    very next measured sample fires `cost_model_drift`
+    (`IGG_PERF_DRIFT_TOL`), which is the :mod:`igg.heal` re-calibration
+    loop's trigger."""
+
+    def __init__(self, family: str, s_per_step: float):
+        self._family = family
+        self._s = float(s_per_step)
+        self._prev = None
+
+    def arm(self) -> "StaleCalibration":
+        from . import perf
+
+        with perf._lock:
+            self._prev = perf._PREDICTIONS.get(self._family)
+        perf.predict(self._family, self._s, source="chaos")
+        return self
+
+    def disarm(self) -> None:
+        from . import perf
+
+        with perf._lock:
+            cur = perf._PREDICTIONS.get(self._family)
+            if cur is None or cur.get("source") != "chaos":
+                return   # a recalibration replaced the injection: keep it
+            if self._prev is None:
+                perf._PREDICTIONS.pop(self._family, None)
+            else:
+                perf._PREDICTIONS[self._family] = self._prev
+
+    def __enter__(self) -> "StaleCalibration":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def stale_calibration(family: str, s_per_step: float) -> StaleCalibration:
+    """Context manager installing a WRONG cost-model prediction for
+    `family` (e.g. 10x the true step time — the stale-calibration fault
+    of PAPERS 2406.08923, worth 1.5-2x when left to rot): the next
+    measured sample exceeds ``IGG_PERF_DRIFT_TOL`` and fires
+    ``cost_model_drift``, driving the :mod:`igg.heal` drift →
+    re-calibrate loop.  Note the heal action REPLACES the registration
+    (`igg.perf.predict` re-anchored to measurement), so `disarm()`
+    restores the pre-chaos prediction only if no recalibration
+    happened."""
+    return StaleCalibration(family, s_per_step)
 
 
 class JobChaos:

@@ -849,10 +849,31 @@ def _await_files(base: pathlib.Path, names, what: str,
         time.sleep(0.05)
 
 
-def _write_atomic_text(p: pathlib.Path, text: str) -> None:
+def _write_atomic_text(p: pathlib.Path, text: str,
+                       durable: bool = False) -> None:
     import os
 
     tmp = p.with_name(p.name + ".tmp")
+    if durable:
+        # Commit records (the fleet journal, the generation manifest
+        # seal): fsync the tmp file BEFORE the atomic rename — without
+        # it, a power cut can reorder the rename ahead of the data
+        # blocks and leave a committed name pointing at torn bytes —
+        # then fsync the directory so the rename itself survives.
+        with open(tmp, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, p)
+        try:
+            fd = os.open(p.parent, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass   # directory fsync unsupported here: best effort
+        return
     tmp.write_text(text)
     os.replace(tmp, p)
 
@@ -1080,7 +1101,12 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
         manifest = {"format": _FORMAT, **_meta(grid), "dtypes": dtypes,
                     "local_shapes": local_shapes, "shards": shards,
                     "attempt": token}
-        _write_atomic_text(staging / _MANIFEST, json.dumps(manifest))
+        # durable=True: the manifest IS the generation's commit record —
+        # fsync before the rename, so a power cut mid-seal can never
+        # leave a manifest name pointing at torn bytes (the same
+        # treatment as the fleet queue journal).
+        _write_atomic_text(staging / _MANIFEST, json.dumps(manifest),
+                           durable=True)
         # Commit.  `os.replace` cannot atomically replace a non-empty
         # directory, so an existing committed generation at `path` is
         # RENAMED aside (atomic) rather than deleted in place: the crash

@@ -720,7 +720,7 @@ def make_stall_watchdog(run: str = "resilient") -> Optional[StallWatchdog]:
 # Per-rank skew
 # ---------------------------------------------------------------------------
 
-def rank_skew(records: Sequence[dict]) -> Dict:
+def rank_skew(records: Sequence[dict], *, publish: bool = False) -> Dict:
     """Worst-vs-median window time per matching step across merged rank
     streams: `records` are merged event dicts
     (:func:`igg.telemetry.merge_streams` output); every step at which
@@ -729,7 +729,15 @@ def rank_skew(records: Sequence[dict]) -> Dict:
     Returns ``{"per_step": [...], "max_skew_ms", "ranks"}`` and
     publishes the maximum as the ``igg_rank_skew_ms`` gauge.  Window
     times are per-rank durations, so host clock offsets (reported by
-    the merge tool's ``merge_summary``) cannot skew this number."""
+    the merge tool's ``merge_summary``) cannot skew this number.
+
+    `publish=True` additionally emits a ``rank_skew`` bus record — the
+    multi-rank straggler feed an attached :class:`igg.heal.HealEngine`
+    consumes as a live re-tile trigger.  Default OFF: this function is
+    also the offline analysis behind ``python -m igg.comm report``, and
+    an analysis of historical (possibly another run's) streams must
+    never look like a live verdict to a heal engine in the same
+    process."""
     by_step: Dict[int, Dict[int, float]] = {}
     ranks = set()
     for r in records:
@@ -762,6 +770,13 @@ def rank_skew(records: Sequence[dict]) -> Dict:
                          "worst_rank": worst_rank, "skew_ms": skew})
     if per_step:
         _telemetry.gauge("igg_rank_skew_ms").set(max_skew)
+        if publish:
+            worst = max(per_step, key=lambda r: r["skew_ms"])
+            _telemetry.emit("rank_skew", step=worst["step"],
+                            max_skew_ms=max_skew,
+                            median_ms=worst["median_ms"],
+                            worst_rank=worst["worst_rank"],
+                            ranks=len(ranks))
     return {"per_step": per_step, "max_skew_ms": max_skew,
             "ranks": sorted(ranks)}
 

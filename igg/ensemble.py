@@ -1034,8 +1034,13 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     except BaseException as e:
         # ResilienceError (all members quarantined) and any unhandled
         # escape: dump the flight recorder wherever a sink is configured,
-        # then re-raise untouched.
-        _telemetry._auto_dump(f"run_ensemble: {type(e).__name__}: {e}")
+        # then re-raise — a ResilienceError additionally carries the dump
+        # path(s), so the exception message NAMES the operator's first
+        # postmortem artifact.
+        paths = _telemetry._auto_dump(f"run_ensemble: "
+                                      f"{type(e).__name__}: {e}")
+        if isinstance(e, ResilienceError):
+            e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
         raise
     finally:
         if installed:

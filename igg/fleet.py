@@ -62,7 +62,7 @@ from . import shared
 from . import telemetry as _telemetry
 from .shared import GridError, NDIMS
 from .resilience import Event, ResilienceError, clear_preemption, \
-    preemption_requested, request_preemption
+    preemption_requested, preemption_requests, request_preemption
 
 __all__ = ["Job", "JobOutcome", "FleetResult", "run_fleet", "plan_dims"]
 
@@ -74,7 +74,7 @@ _JOURNAL_FORMAT = "igg-fleet-journal-v1"
 # telemetry bus from inside the run).
 _SCHEDULER_KINDS = frozenset({
     "job_started", "job_done", "job_failed", "job_gave_up",
-    "job_requeued", "job_preempted", "job_resumed",
+    "job_requeued", "job_preempted", "job_resumed", "heal_repack",
 })
 
 # Chaos seam (igg.chaos.scheduler_fault / job_preempt_at): a dict
@@ -132,6 +132,12 @@ class Job:
     steps_per_call: int = 1
     packing: str = "auto"
     chaos: object = None
+    # Cost-model expectation for the igg.heal lagging-job loop: a job
+    # whose measured member_steps_per_s falls below
+    # `HealPolicy.throughput_tol` × this rate (sustained) is preempted at
+    # the next generation and re-admitted at a different member packing.
+    # None: the engine falls back to the job's own healthy baseline.
+    expected_member_steps_per_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -239,7 +245,12 @@ def _read_journal(path: pathlib.Path) -> dict:
 def _write_journal(path: pathlib.Path, journal: dict) -> None:
     from .checkpoint import _write_atomic_text
 
-    _write_atomic_text(path, json.dumps(journal, indent=1, sort_keys=True))
+    # durable=True: the journal is the queue's COMMIT RECORD — fsync the
+    # tmp file before the atomic rename (and the directory after), so a
+    # power cut mid-commit can never leave a torn journal that
+    # resume=True misparses as "everything queued".
+    _write_atomic_text(path, json.dumps(journal, indent=1, sort_keys=True),
+                       durable=True)
 
 
 # ---------------------------------------------------------------------------
@@ -269,7 +280,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
               backoff: Optional[float] = None,
               install_sigterm: bool = True,
               on_event: Optional[Callable[[Event], None]] = None,
-              telemetry=None) -> FleetResult:
+              telemetry=None, heal=None) -> FleetResult:
     """Drain `jobs` in order onto the live devices (module docstring for
     the full contract).  The caller must NOT hold an initialized grid —
     the scheduler owns grid lifecycle per job.  `resume=True` reconciles
@@ -281,7 +292,19 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
     (:mod:`igg.telemetry` — the :func:`igg.run_resilient` contract) for
     the WHOLE drain: job lifecycle spans, a fleet queue-depth gauge,
     per-status job counters, and every job-scoped event on one
-    rank-tagged JSONL stream."""
+    rank-tagged JSONL stream.
+
+    `heal` attaches the self-healing control plane (:mod:`igg.heal` —
+    the :func:`igg.run_resilient` coercion: None = ``IGG_HEAL``-driven,
+    True/policy/engine/False): a job whose measured
+    ``member_steps_per_s`` falls below the policy's `throughput_tol` ×
+    its `Job.expected_member_steps_per_s` (or its own healthy baseline)
+    for `sustain` windows is preempted at the next generation (it writes
+    its final ring generation — the PR-6 path) and re-admitted
+    IMMEDIATELY at a different member packing (grid ↔ batch when
+    admissible, else a halved device pool), resuming elastically from
+    its ring — a `heal_repack` event per re-admission, budget/cool-down
+    governed like every heal action."""
     import jax
 
     if shared.grid_is_initialized():
@@ -328,6 +351,15 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
         tel.attach()
     _telemetry.emit("run_started", run="fleet", jobs=len(jobs),
                     resume=resume)
+    # Self-healing control plane (igg.heal): the lagging-job → repack
+    # loop — the engine watches each job's nested step_stats windows and
+    # preempts a job measuring below its cost-model expectation; the
+    # scheduler re-admits it at a different member packing below.
+    from . import heal as _heal
+
+    heal_eng = _heal.as_engine(heal, run="fleet")
+    if heal_eng is not None:
+        heal_eng.attach()
     m_queue = _telemetry.gauge("igg_fleet_queue_depth")
 
     def _queue_depth() -> int:
@@ -379,7 +411,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
                                  resume=resume_job):
                 outcome = _run_job(job, workdir / "jobs" / job.name, devs,
                                    resume_job, max_job_retries, backoff,
-                                   _emit, _transition, rec, tel)
+                                   _emit, _transition, rec, tel, heal_eng)
             outcomes[job.name] = outcome
             _telemetry.counter("igg_fleet_jobs_total",
                                status=outcome.status).inc()
@@ -405,6 +437,8 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
         _telemetry._auto_dump(f"run_fleet: {type(e).__name__}: {e}")
         raise
     finally:
+        if heal_eng is not None:
+            heal_eng.detach()
         if installed:
             signal.signal(signal.SIGTERM, old_handler)
             # Owner-only clear (the igg.ensemble rule): with
@@ -426,9 +460,29 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
                        journal=jpath)
 
 
+def _repack_choice(job: Job, served: str, devs) -> Tuple[str, list]:
+    """A DIFFERENT member packing for a lagging job (the igg.heal repack
+    loop): flip grid ↔ batch when the flip is admissible (batch needs the
+    whole interior on one device and `members % n_devices == 0`), else
+    keep the packing on a halved device pool — either way the members
+    land on the devices differently, which is the point of re-admission."""
+    if served != "batch":
+        try:
+            plan_dims(job.global_interior, 1, periods=job.periods,
+                      overlaps=job.overlaps)
+            fits_one = True
+        except GridError:
+            fits_one = False
+        if fits_one and len(devs) > 1 and job.members % len(devs) == 0:
+            return "batch", list(devs)
+    else:
+        return "grid", list(devs)
+    return served, list(devs)[:max(1, len(devs) // 2)]
+
+
 def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
              max_job_retries: int, backoff: float, _emit, _transition,
-             rec, tel) -> JobOutcome:
+             rec, tel, heal_eng=None) -> JobOutcome:
     """Launch one job with retry/exponential-backoff around LAUNCHER
     faults (grid init, decomposition planning, state build, compile) —
     a fault inside the run itself is the ensemble tier's problem."""
@@ -450,6 +504,9 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
     #                             preempted/resumed several times keeps
     #                             its full fault tolerance each time
     delay = backoff
+    packing = job.packing       # rebindable: a heal repack re-admits the
+    launch_devs = list(devs)    # job at a different packing/device pool
+    expected_rate = job.expected_member_steps_per_s
     while True:
         attempt += 1
         _transition(job, status="running", attempts=attempt)
@@ -462,7 +519,7 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
             # Batch packing needs the degenerate single-device grid (the
             # member axis, not the domain, spans the devices); otherwise
             # pack the domain onto as many devices as divide it.
-            cap = 1 if job.packing == "batch" else len(devs)
+            cap = 1 if packing == "batch" else len(launch_devs)
             dims, local = plan_dims(job.global_interior, cap,
                                     periods=job.periods,
                                     overlaps=job.overlaps)
@@ -472,7 +529,7 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                 periodx=job.periods[0], periody=job.periods[1],
                 periodz=job.periods[2], overlapx=job.overlaps[0],
                 overlapy=job.overlaps[1], overlapz=job.overlaps[2],
-                devices=devs[:ndev], quiet=True)
+                devices=launch_devs[:ndev], quiet=True)
             try:
                 grid = igg.get_global_grid()
                 step_fn = (job.make_step(grid) if job.make_step is not None
@@ -487,26 +544,47 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                         chaos = ChaosPlan(preempt_at=pre["step"])
                     else:
                         chaos.preempt_at = pre["step"]
+                # Chaos throughput collapse (igg.chaos.throughput_collapse):
+                # consumed one-shot at THIS launch — the rate limit on the
+                # probe-readiness channel collapses measured member rates
+                # for this launch only, so a heal re-admission runs clean.
+                collapse = _consume_tap("collapse", job.name)
+                slowdown = None
+                if collapse is not None:
+                    from .chaos import FetchDelay
+
+                    slowdown = FetchDelay(collapse["delay_s"]).arm()
                 job_event(Event("job_started", 0,
                                 {"attempt": attempt, "dims": list(dims),
-                                 "devices": ndev, "resume": resume_job}))
+                                 "devices": ndev, "resume": resume_job,
+                                 "packing": packing}))
+                if heal_eng is not None:
+                    heal_eng.watch_job(job.name, expected_rate)
                 # The drain's session is passed THROUGH (already attached,
                 # so the run neither re-attaches nor detaches it, and the
                 # periodic metrics export runs at the watch cadence);
                 # telemetry=False when the drain has none — the nested run
                 # must not auto-attach a second session off
                 # IGG_TELEMETRY_DIR onto the same files.
-                res = run_ensemble(
-                    step_fn, states, job.n_steps, members=job.members,
-                    watch_every=job.watch_every,
-                    checkpoint_dir=jobdir,
-                    checkpoint_every=job.checkpoint_every, ring=job.ring,
-                    member_retries=job.member_retries,
-                    resume=resume_job, steps_per_call=job.steps_per_call,
-                    packing=job.packing, devices=devs,
-                    install_sigterm=False, on_event=job_event,
-                    telemetry=tel if tel is not None else False,
-                    chaos=chaos)
+                try:
+                    res = run_ensemble(
+                        step_fn, states, job.n_steps, members=job.members,
+                        watch_every=job.watch_every,
+                        checkpoint_dir=jobdir,
+                        checkpoint_every=job.checkpoint_every,
+                        ring=job.ring,
+                        member_retries=job.member_retries,
+                        resume=resume_job,
+                        steps_per_call=job.steps_per_call,
+                        packing=packing, devices=launch_devs,
+                        install_sigterm=False, on_event=job_event,
+                        telemetry=tel if tel is not None else False,
+                        chaos=chaos)
+                finally:
+                    if slowdown is not None:
+                        slowdown.disarm()
+                    if heal_eng is not None:
+                        heal_eng.unwatch_job()
                 if resume_job and any(e.kind == "resume"
                                       for e in res.events):
                     job_event(Event("job_resumed",
@@ -518,6 +596,17 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
         except Exception as e:          # launcher fault: retry with backoff
             if igg.grid_is_initialized():
                 igg.finalize_global_grid()
+            if heal_eng is not None:
+                # A repack planned for THIS job must not outlive its
+                # failure: consume the plan and the engine's preemption
+                # request, or the drain would misread the leaked flag as
+                # an operator SIGTERM and stop the whole fleet (a real
+                # SIGTERM racing the clear is re-raised, as above).
+                rc = heal_eng.take_repack(job.name)
+                if rc is not None:
+                    clear_preemption()
+                    if preemption_requests() > rc:
+                        request_preemption()
             job_event(Event("job_failed", 0,
                             {"attempt": attempt,
                              "error": f"{type(e).__name__}: {e}"}))
@@ -557,6 +646,60 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                 return _requeued()
             continue
 
+        repack_count = (heal_eng.take_repack(job.name)
+                        if heal_eng is not None else None)
+        if (repack_count is not None
+                and preemption_requests() > repack_count):
+            # An ADDITIONAL preemption request (an operator SIGTERM)
+            # raced the heal action: honor it — the clear below would
+            # swallow a real shutdown.  The job stands preempted in the
+            # journal; a resume re-admits it (and may repack then).
+            repack_count = None
+        if res.preempted and repack_count is not None:
+            # Loop 3 (igg.heal): the preemption was the heal engine's
+            # doing — the job measured below its cost-model expectation
+            # and wrote its final generation on the way out.  Re-admit it
+            # IMMEDIATELY at a different member packing, resuming
+            # elastically from the ring.  The engine's preemption request
+            # is consumed here (owner-clear: run_ensemble ran with
+            # install_sigterm=False, so the flag is this scheduler's).
+            clear_preemption()
+            if preemption_requests() > repack_count:
+                # A SIGTERM slipped in between the guard above and the
+                # clear: restore the flag — the drain must still stop.
+                request_preemption()
+            new_packing, new_devs = _repack_choice(job, res.packing,
+                                                   launch_devs)
+            if expected_rate is not None and len(new_devs) < len(
+                    launch_devs):
+                # A halved pool halves the deliverable rate: scale the
+                # cost-model expectation, or the re-admitted job would
+                # near-certainly re-signal lag against the stale one.
+                expected_rate *= len(new_devs) / len(launch_devs)
+            _transition(job, status="preempted", attempts=attempt,
+                        steps_done=res.steps_done,
+                        quarantined=res.quarantined, dims=list(dims))
+            job_event(Event("heal_repack", res.steps_done,
+                            {"from_packing": res.packing,
+                             "packing": new_packing,
+                             "from_devices": len(launch_devs),
+                             "devices": len(new_devs),
+                             "reason": "throughput_lag"}))
+            heal_eng.record_done("repack", job=job.name,
+                                 from_packing=res.packing,
+                                 packing=new_packing)
+            packing, launch_devs = new_packing, new_devs
+            resume_job = True
+            continue
+        if not res.preempted and repack_count is not None:
+            # The job finished before the engine's preemption request
+            # landed: nothing to repack — consume the stale request so
+            # the drain does not misread it as an operator SIGTERM
+            # (a racing operator signal was already detected above and
+            # left the flag standing).
+            clear_preemption()
+            if preemption_requests() > repack_count:
+                request_preemption()   # a SIGTERM raced the clear: honor it
         status = "preempted" if res.preempted else "done"
         _transition(job, status=status, attempts=attempt,
                     steps_done=res.steps_done,

@@ -83,8 +83,8 @@ __all__ = [
     "enabled", "ledger_path", "record", "query", "best", "predict",
     "calibrate", "observe_window", "window_state", "sample_context",
     "device_context", "bytes_per_step", "hbm_peak_gbps", "save", "load",
-    "merge_ledgers", "reset", "compare_rows", "compare_paths",
-    "LEDGER_FORMAT",
+    "merge_ledgers", "reset", "invalidate", "forget_prediction",
+    "compare_rows", "compare_paths", "LEDGER_FORMAT",
 ]
 
 LEDGER_FORMAT = "igg-perf-ledger-v1"
@@ -359,6 +359,15 @@ def predict(family: str, compute_s_per_step: float, *,
         _telemetry.gauge("igg_cost_model_rel_error", family=family).set(rel)
 
 
+def forget_prediction(family: str) -> None:
+    """Unregister `family`'s cost-model prediction (the
+    :func:`igg.heal.recalibrate` action drops the stale registration
+    FIRST, so the fresh samples it records cannot re-fire
+    ``cost_model_drift`` against the very prediction being replaced)."""
+    with _lock:
+        _PREDICTIONS.pop(family, None)
+
+
 def query(family: Optional[str] = None, *, tier: Optional[str] = None,
           local_shape=None, dtype=None, dims=None, backend=None,
           device_kind=None) -> List[Dict]:
@@ -409,6 +418,35 @@ def reset() -> None:
         _DRIFT_EMITTED.clear()
         _PERSISTED.clear()
         _last_save = 0.0
+
+
+def invalidate(family: str, tier: Optional[str] = None) -> int:
+    """Drop every in-memory ledger entry for `family` (optionally one
+    `tier`) and re-arm the family's once-per-(family, tier)
+    ``cost_model_drift`` events — the :mod:`igg.heal` re-calibration
+    loop's first step: a drifted calibration must stop serving
+    ``query()/best()`` answers BEFORE fresh samples replace it.  The
+    entries are also dropped from the per-file persisted baselines, so a
+    later :func:`save` merges the replacement samples into the on-disk
+    ledger as new deltas (the file keeps the old aggregates as history —
+    merge-on-write is append-only by design).  Emits one
+    ``perf_invalidated`` bus record; returns the number of entries
+    dropped."""
+    with _lock:
+        keys = [k for k in _LEDGER
+                if k[0] == family and (tier is None or k[1] == tier)]
+        for k in keys:
+            del _LEDGER[k]
+        for base in _PERSISTED.values():
+            for k in [b for b in base
+                      if b[0] == family and (tier is None or b[1] == tier)]:
+                del base[k]
+        for dk in [d for d in _DRIFT_EMITTED if d[0] == family
+                   and (tier is None or d[1] == tier)]:
+            _DRIFT_EMITTED.discard(dk)
+    _telemetry.emit("perf_invalidated", family=family, tier=tier,
+                    entries=len(keys))
+    return len(keys)
 
 
 # ---------------------------------------------------------------------------

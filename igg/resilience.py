@@ -100,24 +100,50 @@ class ResilienceError(GridError):
     event history up to the failure as `.events` (the same
     :class:`Event` list a successful run returns in `RunResult.events`),
     so a postmortem sees every detection, rollback, and degradation that
-    led here — not just the final message."""
+    led here — not just the final message.  When a telemetry sink is
+    configured, the run loop's auto-dump hook fills `.dump_paths` with
+    the flight-recorder dump file(s) it wrote on the way out, and the
+    message NAMES them — the operator's first postmortem artifact is in
+    the exception, not hunted for."""
 
     def __init__(self, message: str, events: Sequence["Event"] = ()):
         super().__init__(message)
         self.events: List[Event] = list(events)
+        self.dump_paths: List[pathlib.Path] = []
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        if self.dump_paths:
+            paths = ", ".join(str(p) for p in self.dump_paths)
+            return f"{base}  [flight recorder dumped to: {paths}]"
+        return base
 
 
 # Process-wide preemption flag.  threading.Event so a SIGTERM delivered on
 # the main thread is visible to a loop running anywhere, and so
 # igg.chaos can simulate preemption deterministically.
 _preempt = threading.Event()
+# Monotone request counter next to the flag: a consumer that CLEARS the
+# flag after handling its own request (the igg.heal repack path in
+# igg.fleet) compares the count against the one its request produced —
+# an ADDITIONAL request (an operator SIGTERM racing the heal action)
+# raises the count further and must not be swallowed by the clear.
+_preempt_requests = 0
 
 
 def request_preemption(signum=None, frame=None) -> None:
     """Ask the running :func:`run_resilient` loop to checkpoint and exit at
     the next dispatch boundary.  Signature doubles as a signal handler
     (`run_resilient` installs it for SIGTERM by default)."""
+    global _preempt_requests
+    _preempt_requests += 1
     _preempt.set()
+
+
+def preemption_requests() -> int:
+    """Monotone count of :func:`request_preemption` calls this process
+    (never reset by :func:`clear_preemption`)."""
+    return _preempt_requests
 
 
 def preemption_requested() -> bool:
@@ -210,6 +236,19 @@ def _is_ready(x) -> bool:
         return True
 
 
+def _buffer_ready(x) -> bool:
+    """Raw buffer readiness, NOT routed through the chaos probe-fetch
+    seam: the async checkpoint writer polls plain per-device snapshot
+    buffers, not a collective-readiness channel — an injected
+    collective stall must stall the watchdog's verdict stream (and fire
+    the heartbeat), never deadlock a background generation write whose
+    data is actually there."""
+    try:
+        return x.is_ready()
+    except AttributeError:
+        return True
+
+
 def _is_deleted(x) -> bool:
     """Whether a snapshot buffer has been invalidated (donated to a later
     dispatch) — the async-checkpoint hazard the writer detects."""
@@ -289,7 +328,7 @@ class _AsyncCheckpointWriter:
                         "fetched them — step_fn donates its inputs; "
                         "subsequent generations degrade to synchronous "
                         "writes")
-                if all(_is_ready(a) for a in fields.values()):
+                if all(_buffer_ready(a) for a in fields.values()):
                     break
                 time.sleep(0.002)
             path = self._save_fn(step, fields, last_good)
@@ -384,6 +423,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   on_event: Optional[Callable[[Event], None]] = None,
                   telemetry=None,
                   comm=None,
+                  heal=None,
                   chaos=None) -> RunResult:
     """Drive `state = step_fn(state)` for `n_steps` steps with a device-side
     NaN/Inf watchdog, a rolling checkpoint ring, rollback-and-retry, and
@@ -447,6 +487,23 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
       emits a `collective_stall` event, a `stall_r<rank>.json` report,
       and a flight-recorder dump instead of hanging silently
       (docs/observability.md, "Stall detection").
+    - `heal`: the self-healing control plane (:mod:`igg.heal`) — None
+      (default: on only when ``IGG_HEAL=1``), True (env-policy engine),
+      an :class:`igg.heal.HealPolicy`, an :class:`igg.heal.HealEngine`,
+      or False (off).  The engine subscribes to the event bus for the
+      run and closes the detection→action loops at dispatch boundaries:
+      a ``collective_stall`` verdict or sustained watchdog-window
+      inflation seals a final generation and elastically RE-TILES the
+      run onto the surviving devices at newly planned ``dims`` (the
+      live grid is re-initialized — single-controller only, warned off
+      on multi-process runs; requires the checkpoint ring); a
+      ``cost_model_drift`` event invalidates the affected
+      :mod:`igg.perf` entries and re-calibrates.  Budget, cool-down,
+      and escalation (action → demote →
+      :class:`igg.heal.HealEscalation`) per the policy; every decision
+      is a typed ``heal_*`` bus record.  With no fault present the
+      engine costs the hot loop one deque check per iteration — zero
+      host syncs (the PR-7 sentinel runs with it enabled).
     - `chaos`: an :class:`igg.chaos.ChaosPlan` for deterministic fault
       injection (CI/testing only).
 
@@ -549,6 +606,23 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
 
     stall = (_comm.make_stall_watchdog("resilient")
              if (watch and watch_every) else None)
+    # Self-healing control plane (igg.heal): the engine subscribes to
+    # the bus for the run's duration; actions are executed at dispatch
+    # boundaries below.  Single-controller only — a mid-run grid
+    # re-initialization cannot be coordinated through a desynchronized
+    # multi-process collective stream (the comm-monitor precedent).
+    from . import heal as _heal
+
+    heal_eng = _heal.as_engine(heal, run="resilient")
+    if heal_eng is not None and jax.process_count() > 1:
+        import warnings
+
+        warnings.warn(
+            "igg.run_resilient: heal= is single-controller only (an "
+            "elastic re-tile re-initializes the live grid, which cannot "
+            "be coordinated mid-run across controller processes); "
+            "disabled for this run.", stacklevel=2)
+        heal_eng = None
     comm_mon = None
     if comm is not None:
         if not (hasattr(comm, "maybe_dispatch") and hasattr(comm, "poll")):
@@ -571,6 +645,12 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 "run.", stacklevel=2)
         else:
             comm_mon = comm
+
+    # Subscribe AFTER the argument validations above: a GridError there
+    # must not leak the engine into the process-global subscriber list
+    # (the pre-loop except and the main finally both detach).
+    if heal_eng is not None:
+        heal_eng.attach()
 
     steps_done = 0
     resumed_step = None
@@ -601,8 +681,13 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         probe = _make_probe() if (watch and watch_every) else None
     except BaseException as e:
         # A pre-loop failure must not leak the run-owned session into the
-        # process-global sink list.
-        _telemetry._auto_dump(f"run_resilient: {type(e).__name__}: {e}")
+        # process-global sink list (nor the heal engine's subscription).
+        paths = _telemetry._auto_dump(f"run_resilient: "
+                                      f"{type(e).__name__}: {e}")
+        if isinstance(e, ResilienceError):
+            e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
+        if heal_eng is not None:
+            heal_eng.detach()
         if tel_owns:
             tel.detach()
         raise
@@ -862,6 +947,153 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             elif out is not None:
                 state = out
 
+    def _heal_retile(act) -> bool:
+        """Loop 1's action (igg.heal): seal a final generation, fence the
+        suspect device(s), re-plan `dims` over the survivors, re-init the
+        grid, and resume elastically from the sealed generation — the
+        PR-4 redistribute restore driven by a detection instead of an
+        operator.  Returns True when the loop must `continue` (the state
+        and decomposition changed)."""
+        nonlocal state, steps_done, last_good, last_ckpt, \
+            last_ckpt_step, comm_mon, stats, run_stamp
+        if cdir is None:
+            # Budget-refunded skip: a retile without a ring is
+            # unactionable for the whole run — it must neither escalate
+            # nor be re-planned.
+            heal_eng.record_skipped("retile", reason="no_checkpoint_ring")
+            _telemetry.emit("heal_skipped", step=steps_done,
+                            run="resilient", action="retile",
+                            why="no checkpoint ring to seal/resume from")
+            return False
+        from .finalize import finalize_global_grid
+        from .init import init_global_grid
+
+        grid = shared.global_grid()
+        old_dims, old_ndev = tuple(grid.dims), grid.nprocs
+        try:
+            devs, new_dims, new_local = heal_eng.plan_retile(
+                grid, suspects=act.get("suspects"))
+        except GridError as e:
+            heal_eng.record_skipped("retile", reason=str(e))
+            _telemetry.emit("heal_skipped", step=steps_done,
+                            run="resilient", action="retile",
+                            why=f"no decomposition fits the survivors: {e}")
+            return False
+        # Seal the handoff generation: every in-flight background write
+        # settled first, then a synchronous write unless a generation at
+        # this exact step already holds this state.
+        _merge_writer(drain=True)
+        if steps_done not in synced:
+            _save_gen(steps_done)
+        pending.clear()
+        if stall is not None:
+            stall.clear()
+        periods, overlaps = tuple(grid.periods), tuple(grid.overlaps)
+        with _telemetry.span("heal.retile", step=steps_done,
+                             from_dims=list(old_dims),
+                             dims=list(new_dims)):
+            finalize_global_grid()
+            init_global_grid(
+                *new_local, dimx=new_dims[0], dimy=new_dims[1],
+                dimz=new_dims[2], periodx=periods[0], periody=periods[1],
+                periodz=periods[2], overlapx=overlaps[0],
+                overlapy=overlaps[1], overlapz=overlaps[2],
+                devices=devs, quiet=True)
+            found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True)
+            if found is None:
+                raise ResilienceError(
+                    f"igg.heal: elastic re-tile at step {steps_done} found "
+                    f"no healthy generation under {cdir} to resume from.",
+                    events)
+            state = ckpt.load_checkpoint(found, redistribute=True)
+            steps_done = ckpt.checkpoint_step(found) or 0
+        # Everything compiled on the retiled-away mesh re-traces lazily
+        # (igg.sharded keys on the grid epoch); run-scoped bookkeeping is
+        # re-anchored here.  finalize cleared the ladder, so the demotion
+        # scope stamp restarts too.
+        run_stamp = _degrade.dispatch_stamp()
+        synced.clear()
+        synced.add(steps_done)
+        last_good = steps_done
+        last_ckpt, last_ckpt_step = found, steps_done
+        stats = _telemetry.StepStats(
+            "resilient",
+            perf=(_perf.sample_context(state[watch[0]])
+                  if watch and _perf.enabled() else None))
+        if comm_mon is not None:
+            # Its decomposition probe programs hold the dead mesh, and a
+            # monitor cannot be rebuilt without the caller's compute fn.
+            try:
+                comm_mon.finalize(steps_done)
+            except Exception:
+                pass
+            comm_mon = None
+            _telemetry.emit("heal_skipped", step=steps_done,
+                            run="resilient", action="comm_monitor",
+                            why="decomposition probes were built on the "
+                                "retiled-away mesh; monitor detached")
+        heal_eng.record_done("retile", from_dims=list(old_dims),
+                             dims=list(new_dims), devices=len(devs),
+                             step=steps_done)
+        # The smaller surviving grid is legitimately slower per step —
+        # the straggler detector must re-baseline, not compare against
+        # the old topology.
+        heal_eng.reset_baseline()
+        _emit("heal_retile", steps_done, from_dims=list(old_dims),
+              from_devices=old_ndev, dims=list(new_dims),
+              devices=len(devs), path=str(found),
+              reason=act.get("reason"))
+        return True
+
+    def _heal_act() -> bool:
+        """Execute the heal engine's next planned action at this dispatch
+        boundary; True means the loop must `continue` (state changed)."""
+        act = heal_eng.pop()
+        if act is None:
+            return False
+        kind = act["action"]
+        if kind == "retile":
+            return _heal_retile(act)
+        if kind == "recalibrate":
+            from . import heal as _heal_mod
+
+            fam = act.get("family")
+            if fam:
+                with _telemetry.span("heal.recalibrate", step=steps_done,
+                                     family=fam):
+                    sec = _heal_mod.recalibrate(fam, tier=act.get("tier"))
+                heal_eng.record_done("recalibrate", family=fam,
+                                     measured_s_per_step=sec)
+                # `recalibrate` just emitted the authoritative
+                # `recalibrated` bus record; this is the per-run view's
+                # step-anchored copy only.
+                _emit("heal_recalibrate", steps_done, _bus=False,
+                      family=fam, measured_s_per_step=sec)
+            return False
+        if kind == "demote":
+            demoted = _degrade.demote_active(
+                reason="heal_escalation",
+                error_text=f"heal escalation: "
+                           f"{act.get('escalated_from')} budget exhausted "
+                           f"and the failure signal persists",
+                since=run_stamp)
+            heal_eng.record_done("demote", tiers=demoted)
+            for tname in demoted:
+                _emit("tier_degraded", steps_done, _bus=False, tier=tname,
+                      reason="heal_escalation")
+            return False
+        if kind == "fail":
+            from . import heal as _heal_mod
+
+            raise _heal_mod.HealEscalation(
+                f"igg.heal: the action budget "
+                f"(max_actions={heal_eng.policy.max_actions}) is "
+                f"exhausted, the escalation ladder is walked, and the "
+                f"failure signal ({act.get('escalated_from')}: "
+                f"{act.get('signal_reason')}) persists at step "
+                f"{steps_done}.", events)
+        return False
+
     installed = False
     old_handler = None
     if install_sigterm:
@@ -895,6 +1127,11 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 if _preempt.is_set():
                     preempted = True
                     break
+                # Self-healing actions execute at dispatch boundaries (a
+                # deque check when idle — the heal_overhead contract).
+                if heal_eng is not None and heal_eng.has_pending():
+                    if _heal_act():
+                        continue
                 if chaos is not None:
                     state = chaos.apply(state, steps_done, _emit,
                                         span=steps_per_call)
@@ -1012,10 +1249,17 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     except BaseException as e:
         # ResilienceError, the retry-budget exhaustion path, and any
         # unhandled escape: dump the flight recorder wherever a sink is
-        # configured, then re-raise untouched.
-        _telemetry._auto_dump(f"run_resilient: {type(e).__name__}: {e}")
+        # configured, then re-raise — a ResilienceError additionally
+        # carries the dump path(s), so the exception message NAMES the
+        # operator's first postmortem artifact.
+        paths = _telemetry._auto_dump(f"run_resilient: "
+                                      f"{type(e).__name__}: {e}")
+        if isinstance(e, ResilienceError):
+            e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
         raise
     finally:
+        if heal_eng is not None:
+            heal_eng.detach()
         if comm_mon is not None:
             try:
                 comm_mon.finalize(steps_done)

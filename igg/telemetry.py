@@ -96,7 +96,7 @@ __all__ = [
     "Record", "Telemetry", "emit", "span", "counter", "gauge", "histogram",
     "snapshot", "prometheus_text", "reset_metrics", "flight_recorder",
     "dump_flight_recorder", "export_chrome_trace", "as_session",
-    "merge_streams",
+    "merge_streams", "subscribe", "unsubscribe",
 ]
 
 
@@ -127,6 +127,7 @@ class Record:
 _lock = threading.RLock()
 _RING: Optional[deque] = None        # created lazily (size is an env knob)
 _SESSIONS: List["Telemetry"] = []    # attached sinks
+_SUBSCRIBERS: List = []              # live bus consumers (igg.heal engines)
 _process_cached: Optional[int] = None
 
 
@@ -183,7 +184,36 @@ def emit(kind: str, step: Optional[int] = None, **payload) -> Record:
             sessions = list(_SESSIONS)
         for s in sessions:
             s._write_record(rec)
+    if _SUBSCRIBERS:
+        with _lock:
+            subs = list(_SUBSCRIBERS)
+        for fn in subs:
+            try:
+                fn(rec)
+            except Exception:
+                # A broken consumer (a heal-engine detector mid-teardown)
+                # must never kill the run that is being observed.
+                pass
     return rec
+
+
+def subscribe(fn) -> None:
+    """Register a live bus consumer: `fn(record)` is called for EVERY
+    subsequent :func:`emit`, on the emitting thread (which may be a
+    background thread — the stall heartbeat, the async checkpoint writer).
+    Consumers must be fast and non-blocking (the hot loops emit here) and
+    must never raise (exceptions are swallowed).  This is the
+    detection half of the :mod:`igg.heal` control loops."""
+    with _lock:
+        if fn not in _SUBSCRIBERS:
+            _SUBSCRIBERS.append(fn)
+
+
+def unsubscribe(fn) -> None:
+    """Remove a consumer registered with :func:`subscribe` (idempotent)."""
+    with _lock:
+        if fn in _SUBSCRIBERS:
+            _SUBSCRIBERS.remove(fn)
 
 
 def flight_recorder() -> List[Record]:
@@ -229,14 +259,17 @@ def dump_flight_recorder(reason: str = "requested",
     return out
 
 
-def _auto_dump(reason: str) -> None:
+def _auto_dump(reason: str) -> List[pathlib.Path]:
     """The run loops' fault hook: dump the flight recorder wherever a sink
     is configured (attached session or IGG_TELEMETRY_DIR); silently a no-op
-    when telemetry is entirely unconfigured."""
+    when telemetry is entirely unconfigured.  Returns the dump paths
+    written (empty when unconfigured) so a :class:`igg.ResilienceError`
+    can NAME the operator's first postmortem artifact."""
     with _lock:
         have_session = bool(_SESSIONS)
     if have_session or _env().text("IGG_TELEMETRY_DIR"):
-        dump_flight_recorder(reason)
+        return dump_flight_recorder(reason)
+    return []
 
 
 # ---------------------------------------------------------------------------

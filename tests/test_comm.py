@@ -331,6 +331,52 @@ def test_stall_watchdog_unit_check_and_heal():
     assert icomm.make_stall_watchdog("x") is not None      # default on
 
 
+def test_stall_watchdog_rearm_across_back_to_back_episodes(tmp_path):
+    """The once-per-episode contract the heal loop depends on (round-15
+    satellite): two stalls separated by a FULL channel drain produce
+    exactly two `collective_stall` events and two stall reports — never
+    one (a dead re-arm would starve the second heal action) and never
+    three (a mid-drain double report would burn heal budget on one
+    fault).  A partial drain (one of two in-flight entries retired) must
+    NOT re-arm."""
+
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+    sess = tel.Telemetry(tmp_path).attach()
+    sw = icomm.StallWatchdog(0.01, run="unit", poll_s=10.0)  # manual beats
+    try:
+        # Episode 1: two in-flight entries, over-age -> fires ONCE.
+        sw.watch("a", 5, "unit probe a", NeverReady())
+        sw.watch("b", 7, "unit probe b", NeverReady())
+        time.sleep(0.02)
+        assert sw.check() and not sw.check()
+        report1 = json.loads((tmp_path / "stall_r0.json").read_text())
+        assert report1["step"] == 5
+        # Partial drain: one entry retired, one still in flight — the
+        # episode is NOT over, a new over-age check stays silent.
+        sw.fetched("a", 5)
+        time.sleep(0.02)
+        assert not sw.check()
+        # FULL drain ends the episode and re-arms.
+        sw.fetched("b", 7)
+        # Episode 2: a fresh stall fires again, with a fresh report.
+        sw.watch("c", 11, "unit probe c", NeverReady())
+        time.sleep(0.02)
+        assert sw.check() and not sw.check()
+        assert sw.stalls == 2
+    finally:
+        sw.close()
+        sess.detach()
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    stalls = [r for r in recs if r["kind"] == "collective_stall"]
+    assert [r["step"] for r in stalls] == [5, 11]   # exactly two episodes
+    report2 = json.loads((tmp_path / "stall_r0.json").read_text())
+    assert report2["step"] == 11 and report2 != report1
+
+
 def test_make_stall_watchdog_disabled_by_env(monkeypatch):
     monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0")
     assert icomm.make_stall_watchdog("x") is None

@@ -1,0 +1,544 @@
+"""The self-healing control plane (igg/heal.py) and its round-15
+satellites: the three chaos-proven detection→action loops (stall →
+elastic re-tile, cost-model drift → re-calibration, lagging fleet job →
+repack — each healing bit-exactly with zero operator recovery code),
+the budget/hysteresis governor (a flapping signal cannot exceed the
+action budget; escalation walks action → demote → fail), the
+fsync-hardened journal/manifest commits, and ResilienceError naming its
+flight-recorder dump paths."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import igg
+from igg import heal as iheal
+from igg import telemetry as tel
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Metrics, the flight ring, and the perf ledger are process-global;
+    isolate every test (the test_comm fixture's pattern)."""
+    tel.reset_metrics()
+    tel._ring().clear()
+    igg.perf.reset()
+    yield
+    for s in list(tel._SESSIONS):
+        s.detach()
+    with tel._lock:
+        tel._SUBSCRIBERS.clear()
+    tel.reset_metrics()
+    igg.perf.reset()
+
+
+def _grid(n=8, **kw):
+    args = dict(periodx=1, periody=1, periodz=1, quiet=True)
+    args.update(kw)
+    igg.init_global_grid(n, n, n, **args)
+
+
+def _make_step():
+    from igg.ops import interior_add
+
+    @igg.sharded
+    def step(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(interior_add(T, 0.1 * lap))
+
+    return lambda st: {"T": step(st["T"])}
+
+
+def _init_state(n=8, seed=3):
+    rng = np.random.default_rng(seed)
+    T = igg.from_local_blocks(lambda c, ls: rng.standard_normal(ls),
+                              (n, n, n))
+    return {"T": igg.update_halo(T)}
+
+
+# ---------------------------------------------------------------------------
+# Loop 1: collective stall -> elastic re-tile (chaos, bit-exact)
+# ---------------------------------------------------------------------------
+
+def test_stall_heals_by_elastic_retile_bit_exact(tmp_path, monkeypatch):
+    """The acceptance path: a chaos collective stall TIED TO ONE DEVICE
+    (the sick-chip shape) fires the stall heartbeat; the heal engine
+    seals a final generation, fences the chip, re-plans dims over the
+    survivors, and resumes elastically — the run completes bit-exactly
+    vs an uninterrupted run, with zero operator recovery code (the
+    injected fault heals ITSELF once the sick device leaves the grid)."""
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0.05")
+    nt = 40
+    base = _make_step()
+    # A wall-clock floor per dispatch so the run reliably outlives the
+    # stall heartbeat's deadline on any host (the math is untouched —
+    # bit-exactness is unaffected).
+    slow = lambda st: (time.sleep(0.004), base(st))[1]
+
+    _grid()
+    res = igg.run_resilient(slow, _init_state(), nt, watch_every=2,
+                            install_sigterm=False)
+    ref = np.asarray(igg.gather_interior(res.state["T"]))
+    igg.finalize_global_grid()
+
+    _grid()
+    grid = igg.get_global_grid()
+    assert grid.dims == (2, 2, 2)
+    sick = list(grid.mesh.devices.flat)[-1]   # the engine's default fence
+    eng = iheal.HealEngine(iheal.HealPolicy(max_actions=1, cooldown_s=0.0),
+                           run="resilient")
+    with igg.chaos.collective_stall(device=sick):
+        res2 = igg.run_resilient(
+            slow, _init_state(), nt, watch_every=2,
+            checkpoint_dir=tmp_path / "ring", checkpoint_every=4,
+            max_pending_probes=100, heal=eng,
+            telemetry=tmp_path / "tel", install_sigterm=False)
+    assert res2.steps_done == nt and res2.retries == 0
+    kinds = [e.kind for e in res2.events]
+    assert "heal_retile" in kinds
+    ev = next(e for e in res2.events if e.kind == "heal_retile")
+    assert ev.detail["from_dims"] == [2, 2, 2]
+    assert ev.detail["devices"] < 8           # the sick chip was fenced
+    g2 = igg.get_global_grid()
+    assert sick not in list(g2.mesh.devices.flat)
+    assert tuple(ev.detail["dims"]) == g2.dims != (2, 2, 2)
+    out = np.asarray(igg.gather_interior(res2.state["T"]))
+    np.testing.assert_array_equal(out, ref)   # bit-exact heal
+    assert [a["action"] for a in eng.actions] == ["retile"]
+    # The whole loop is reconstructable from artifacts alone.
+    recs = [json.loads(l) for l in
+            (tmp_path / "tel" / "events_r0.jsonl").read_text().splitlines()]
+    rk = [r["kind"] for r in recs]
+    assert rk.index("collective_stall") < rk.index("heal_planned") \
+        < rk.index("heal_retile")
+
+
+def test_straggler_window_inflation_triggers_retile(tmp_path, monkeypatch):
+    """The soft half of loop 1: igg.chaos.straggler rate-limits probe
+    readiness after a healthy warm-up, measured watchdog windows inflate
+    past skew_tol x the run's own baseline, and the engine re-tiles.
+    The slowdown is observational (the simulation itself is untouched),
+    so the run completes bit-exactly."""
+    nt = 200
+    base = _make_step()
+
+    def slow_step(st):
+        # A wall-clock floor per dispatch: the windows the straggler
+        # inflates (and the baseline under them) stay bounded below on a
+        # fast host and the run outlives the injected slowdown.
+        time.sleep(0.004)
+        return base(st)
+
+    _grid(n=6)
+    res = igg.run_resilient(slow_step, _init_state(6), nt,
+                            watch_every=2, install_sigterm=False)
+    ref = np.asarray(igg.gather_interior(res.state["T"]))
+    igg.finalize_global_grid()
+
+    _grid(n=6)
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=1, cooldown_s=0.0, sustain=2,
+                         skew_tol=3.0, baseline_windows=2,
+                         escalation=()),
+        run="resilient")
+    with igg.chaos.straggler(rank=0, factor=5.0, base_window_s=0.05,
+                             after=8):
+        res2 = igg.run_resilient(
+            slow_step, _init_state(6), nt, watch_every=2,
+            checkpoint_dir=tmp_path / "ring", checkpoint_every=4,
+            max_pending_probes=300, heal=eng,
+            telemetry=tmp_path / "tel", install_sigterm=False)
+    assert res2.steps_done == nt
+    assert [a["action"] for a in eng.actions] == ["retile"]
+    ev = next(e for e in res2.events if e.kind == "heal_retile")
+    assert ev.detail["reason"] == "window_inflation"
+    np.testing.assert_array_equal(
+        np.asarray(igg.gather_interior(res2.state["T"])), ref)
+
+
+def test_retile_without_ring_is_skipped_not_fatal(tmp_path, monkeypatch):
+    """A retile plan with no checkpoint ring has nothing to seal or
+    resume from: the action is skipped with a `heal_skipped` record, the
+    run finishes untouched."""
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0.05")
+    _grid()
+    eng = iheal.HealEngine(iheal.HealPolicy(max_actions=1, cooldown_s=0.0),
+                           run="resilient")
+    base = _make_step()
+    slow = lambda st: (time.sleep(0.006), base(st))[1]   # outlive the stall
+    with igg.chaos.collective_stall():
+        res = igg.run_resilient(slow, _init_state(), 30,
+                                watch_every=2, max_pending_probes=100,
+                                heal=eng, telemetry=tmp_path,
+                                install_sigterm=False)
+    assert res.steps_done == 30
+    assert igg.get_global_grid().dims == (2, 2, 2)   # untouched
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    skips = [r for r in recs if r["kind"] == "heal_skipped"]
+    assert skips and "ring" in skips[0]["payload"]["why"]
+    # A skip refunds the budget and never walks the escalation ladder —
+    # the run completed, no tier was demoted, nothing was raised.
+    assert eng.actions == []
+    assert [s["action"] for s in eng.skipped] == ["retile"]
+    assert not any(r["kind"] == "heal_escalated" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# Loop 2: cost-model drift -> re-calibration (chaos, bit-exact)
+# ---------------------------------------------------------------------------
+
+def test_drift_recalibrates_and_heals_bit_exact(tmp_path):
+    """A stale calibration (10 s/step vs sub-ms reality) fires
+    cost_model_drift on the first watchdog-window sample; the engine
+    invalidates the family's ledger entries, re-measures, re-registers
+    the prediction, and emits `recalibrated` — once (repeats are
+    advisory noise, suppressed).  The run's physics is untouched:
+    bit-exact vs a clean run."""
+    from igg.models import diffusion3d as d3
+
+    def run(**kw):
+        igg.init_global_grid(16, 16, 16, periodx=1, periody=1, periodz=1,
+                             quiet=True)
+        params = d3.Params()
+        T0, Cp = d3.init_fields(params, dtype=np.float32)
+        step = d3.make_step(params, donate=False)
+        res = igg.run_resilient(
+            lambda s: {"T": step(s["T"], s["Cp"]), "Cp": s["Cp"]},
+            {"T": T0, "Cp": Cp}, 40, watch_every=5,
+            install_sigterm=False, **kw)
+        out = np.asarray(igg.gather_interior(res.state["T"]))
+        igg.finalize_global_grid()
+        return res, out
+
+    _, ref = run()
+
+    eng = iheal.HealEngine(iheal.HealPolicy(max_actions=3, cooldown_s=0.0),
+                           run="resilient")
+    with igg.chaos.stale_calibration("diffusion3d", 10.0):
+        res, out = run(heal=eng, telemetry=tmp_path)
+    np.testing.assert_array_equal(out, ref)
+    recals = [a for a in eng.actions if a["action"] == "recalibrate"]
+    assert len(recals) == 1 and recals[0]["family"] == "diffusion3d"
+    # The re-registered prediction is the measurement, not the lie.
+    with igg.perf._lock:
+        pred = dict(igg.perf._PREDICTIONS["diffusion3d"])
+    assert pred["source"] == "heal" and pred["s_per_step"] < 1.0
+    # The whole loop from artifacts alone: drift -> planned ->
+    # invalidated -> recalibrated, in order.
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    rk = [r["kind"] for r in recs]
+    assert rk.index("cost_model_drift") < rk.index("heal_planned") \
+        < rk.index("perf_invalidated") < rk.index("recalibrated")
+    recal = next(r for r in recs if r["kind"] == "recalibrated")
+    assert recal["payload"]["family"] == "diffusion3d"
+    assert recal["payload"]["invalidated"] >= 1
+    assert recal["payload"]["measured_s_per_step"] < 1.0
+
+
+def test_recalibrate_unknown_family_reanchors_from_ledger():
+    """Families igg.perf.calibrate cannot build re-anchor to the
+    freshest measured sample: the measurement IS the truth."""
+    _grid()
+    igg.perf.record("myphysics", "myphysics.xla", 2.5, source="watchdog",
+                    local_shape=(8, 8, 8), dtype="float32",
+                    dims=(2, 2, 2), backend="cpu", device_kind="cpu")
+    igg.perf.predict("myphysics", 99.0)
+    sec = iheal.recalibrate("myphysics")
+    assert sec == pytest.approx(2.5e-3)
+    with igg.perf._lock:
+        assert igg.perf._PREDICTIONS["myphysics"]["s_per_step"] == \
+            pytest.approx(2.5e-3)
+    # The ledger was re-seeded with the anchor sample.
+    e = igg.perf.best("myphysics")
+    assert e is not None and e["best_ms"] == pytest.approx(2.5)
+    # With no measurement at all there is nothing to anchor to: None.
+    assert iheal.recalibrate("neverseen") is None
+
+
+def test_perf_invalidate_drops_entries_and_rearms_drift():
+    igg.perf.record("famA", "famA.xla", 1.0)
+    igg.perf.record("famA", "famA.mosaic", 0.5)
+    igg.perf.record("famB", "famB.xla", 2.0)
+    with igg.perf._lock:
+        igg.perf._DRIFT_EMITTED.add(("famA", "famA.xla"))
+        igg.perf._DRIFT_EMITTED.add(("famB", "famB.xla"))
+    assert igg.perf.invalidate("famA", tier="famA.mosaic") == 1
+    assert [e["tier"] for e in igg.perf.query("famA")] == ["famA.xla"]
+    assert igg.perf.invalidate("famA") == 1
+    assert igg.perf.query("famA") == []
+    assert igg.perf.query("famB") != []
+    with igg.perf._lock:
+        assert ("famA", "famA.xla") not in igg.perf._DRIFT_EMITTED
+        assert ("famB", "famB.xla") in igg.perf._DRIFT_EMITTED
+    assert any(r.kind == "perf_invalidated"
+               for r in tel.flight_recorder())
+
+
+# ---------------------------------------------------------------------------
+# Loop 3: lagging fleet job -> repack (chaos, bit-exact)
+# ---------------------------------------------------------------------------
+
+def test_lagging_job_repacks_bit_exact(tmp_path, monkeypatch):
+    """A fleet job whose measured member rate collapses below its
+    cost-model expectation (igg.chaos.throughput_collapse — a rate
+    limit on the probe-readiness channel, the simulation untouched) is
+    preempted at the next generation and re-admitted at a DIFFERENT
+    member packing (grid -> batch here), resuming elastically from its
+    ring — final member states bit-identical to an uninterrupted
+    drain."""
+    from test_fleet import _job
+
+    monkeypatch.setenv("IGG_ENSEMBLE_MAX_PENDING_PROBES", "1000")
+    caps = {}
+
+    def capture(tag):
+        import igg.ensemble as ens
+
+        orig = ens.run_ensemble
+
+        def wrapper(*a, **kw):
+            res = orig(*a, **kw)
+            if not res.preempted:
+                caps[tag] = np.stack(
+                    [np.asarray(igg.gather_interior(res.state["T"][m]))
+                     for m in range(res.members)])
+            return res
+        return wrapper
+
+    import igg.ensemble as ens
+
+    # 600 steps at >= one collective dispatch each: even on a fast host
+    # the job's wall time spans several 0.02 s readiness grants, so the
+    # collapsed windows (2 steps / 0.02 s x 8 members = 800 member-
+    # steps/s << 0.5 x 5000) are measured BEFORE the job can finish.
+    kw = dict(seed=5, members=8, n_steps=600, packing="grid",
+              watch_every=2, checkpoint_every=20)
+    monkeypatch.setattr(ens, "run_ensemble", capture("clean"))
+    ref = igg.run_fleet([_job("j", **kw)], tmp_path / "clean")
+    assert ref.jobs["j"].status == "done"
+
+    monkeypatch.setattr(ens, "run_ensemble", capture("healed"))
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=1, cooldown_s=0.0, sustain=2),
+        run="fleet")
+    job = _job("j", expected_member_steps_per_s=5000.0, **kw)
+    with igg.chaos.throughput_collapse("j", delay_s=0.02):
+        res = igg.run_fleet([job], tmp_path / "healed", heal=eng)
+    o = res.jobs["j"]
+    assert o.status == "done" and not res.preempted
+    repack = next(e for e in o.events if e.kind == "heal_repack")
+    assert repack.detail["from_packing"] == "grid"
+    assert repack.detail["packing"] == "batch"
+    assert o.result.packing == "batch"
+    assert [a["action"] for a in eng.actions] == ["repack"]
+    np.testing.assert_array_equal(caps["healed"], caps["clean"])
+    # The journal saw the heal preemption and the final completion.
+    j = json.loads((tmp_path / "healed" / "journal.json").read_text())
+    assert j["jobs"]["j"]["status"] == "done"
+    assert j["jobs"]["j"]["attempts"] == 2     # launch + re-admission
+
+
+def test_repack_choice_flips_and_falls_back():
+    from igg.fleet import _repack_choice
+
+    job = igg.Job(name="x", global_interior=(8, 8, 8), members=8,
+                  n_steps=1, make_states=lambda g: [], step_fn=lambda s: s)
+    devs = list(range(8))
+    # grid -> batch when the interior fits one device and M % ndev == 0.
+    assert _repack_choice(job, "grid", devs) == ("batch", devs)
+    # batch -> grid always.
+    assert _repack_choice(job, "batch", devs) == ("grid", devs)
+    # No legal flip (members not divisible): halve the pool instead.
+    job_odd = igg.Job(name="y", global_interior=(8, 8, 8), members=3,
+                      n_steps=1, make_states=lambda g: [],
+                      step_fn=lambda s: s)
+    packing, pool = _repack_choice(job_odd, "grid", devs)
+    assert packing == "grid" and len(pool) == 4
+
+
+# ---------------------------------------------------------------------------
+# The budget/hysteresis governor
+# ---------------------------------------------------------------------------
+
+def test_flapping_signal_cannot_exceed_action_budget():
+    """The acceptance hysteresis test: a signal flapping 30x plans at
+    most `max_actions` actions (escalation disabled); every other
+    decision is an accounted suppression."""
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=2, cooldown_s=0.0, sustain=1,
+                         escalation=()), run="resilient")
+    eng.attach()
+    executed = 0
+    for i in range(30):
+        tel.emit("collective_stall", step=i, run="resilient",
+                 in_flight="probe")
+        act = eng.pop()
+        if act is not None:
+            eng.record_done(act["action"])
+            executed += 1
+    eng.detach()
+    assert executed == 2
+    assert eng.suppressed == 28
+    kinds = [r.kind for r in tel.flight_recorder()]
+    assert kinds.count("heal_planned") == 2
+    assert "heal_suppressed" in kinds
+    assert "heal_escalated" not in kinds
+
+
+def test_cooldown_and_sustain_hysteresis():
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=10, cooldown_s=3600.0, sustain=3),
+        run="resilient")
+    eng.attach()
+    # A soft signal below `sustain` consecutive observations never acts,
+    # and a healthy window in between RESETS the counter.
+    for ms in (10.0, 10.0, 10.0, 50.0, 50.0, 10.0, 50.0, 50.0):
+        tel.emit("step_stats", run="resilient", ms_per_step=ms,
+                 steps_per_s=1e3 / ms, window_steps=2)
+    assert not eng.has_pending()
+    # The third consecutive excess crosses sustain -> one action.
+    for _ in range(3):
+        tel.emit("step_stats", run="resilient", ms_per_step=50.0,
+                 steps_per_s=20.0, window_steps=2)
+    assert eng.has_pending()
+    act = eng.pop()
+    assert act["action"] == "retile"
+    eng.record_done("retile")
+    # Cooldown: an immediate re-signal is suppressed, not planned.
+    for _ in range(3):
+        tel.emit("step_stats", run="resilient", ms_per_step=50.0,
+                 steps_per_s=20.0, window_steps=2)
+    assert not eng.has_pending() and eng.suppressed >= 1
+    eng.detach()
+
+
+def test_escalation_walks_demote_then_fail(tmp_path):
+    """Budget exhausted + persistent signal: the ladder walks demote
+    (quarantine the serving tiers) then fail (HealEscalation — a
+    ResilienceError carrying the flight-dump paths in its message).
+    Signals are injected directly onto the bus (the StallWatchdog's
+    once-per-episode debounce is pinned separately in test_comm)."""
+    _grid()
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=0, cooldown_s=0.0,
+                         escalation=("demote", "fail")), run="resilient")
+    eng.attach()
+    tel.emit("collective_stall", step=1, run="resilient",
+             in_flight="probe")                 # budget 0 -> demote planned
+    tel.emit("collective_stall", step=2, run="resilient",
+             in_flight="probe")                 # ladder walks on -> fail
+    with pytest.raises(iheal.HealEscalation) as ei:
+        igg.run_resilient(_make_step(), _init_state(), 20, watch_every=5,
+                          heal=eng, telemetry=tmp_path,
+                          install_sigterm=False)
+    err = ei.value
+    assert [a["action"] for a in eng.actions] == ["demote"]
+    assert err.dump_paths and "flight recorder dumped to" in str(err)
+    assert all(p.exists() for p in err.dump_paths)
+    assert isinstance(err, igg.ResilienceError)
+    kinds = [r.kind for r in tel.flight_recorder()]
+    assert kinds.count("heal_escalated") == 2
+
+
+def test_policy_validation_and_as_engine_coercion(monkeypatch):
+    with pytest.raises(igg.GridError, match="sustain"):
+        iheal.HealPolicy(sustain=0)
+    with pytest.raises(igg.GridError, match="escalation"):
+        iheal.HealPolicy(escalation=("explode",))
+    assert iheal.as_engine(False) is None
+    assert iheal.as_engine(None) is None          # IGG_HEAL unset: off
+    monkeypatch.setenv("IGG_HEAL", "1")
+    eng = iheal.as_engine(None, run="fleet")
+    assert isinstance(eng, iheal.HealEngine) and eng.run == "fleet"
+    monkeypatch.setenv("IGG_HEAL_MAX_ACTIONS", "7")
+    assert iheal.as_engine(True).policy.max_actions == 7
+    pol = iheal.HealPolicy(max_actions=1)
+    assert iheal.as_engine(pol).policy is pol
+    eng2 = iheal.HealEngine(pol)
+    assert iheal.as_engine(eng2) is eng2
+    with pytest.raises(igg.GridError, match="heal="):
+        iheal.as_engine("bogus")
+
+
+def test_heal_env_knobs_registered():
+    from igg import _env
+
+    for name in ("IGG_HEAL", "IGG_HEAL_MAX_ACTIONS", "IGG_HEAL_COOLDOWN",
+                 "IGG_HEAL_SKEW_TOL", "IGG_HEAL_THROUGHPUT_TOL",
+                 "IGG_HEAL_SUSTAIN"):
+        assert name in _env._KNOWN, name
+
+
+def test_rank_skew_record_feeds_retile_signal():
+    """The multi-rank straggler feed: a rank_skew bus record (emitted by
+    igg.comm.rank_skew) beyond skew_tol plans a retile."""
+    eng = iheal.HealEngine(
+        iheal.HealPolicy(max_actions=1, cooldown_s=0.0, sustain=1,
+                         skew_tol=2.0), run="resilient")
+    eng.attach()
+    tel.emit("rank_skew", step=50, max_skew_ms=30.0, median_ms=10.0,
+             worst_rank=3, ranks=4)
+    act = eng.pop()
+    eng.detach()
+    assert act is not None and act["action"] == "retile"
+    assert act["reason"] == "rank_skew_excess"
+
+
+# ---------------------------------------------------------------------------
+# Satellites: fsync'd commit records, dump-path-carrying errors
+# ---------------------------------------------------------------------------
+
+def test_journal_and_manifest_seal_are_fsynced(tmp_path, monkeypatch):
+    """The power-cut hardening: the fleet journal write and the sharded
+    generation's manifest seal fsync the tmp file before the atomic
+    rename (and the directory after)."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd))[1])
+
+    from igg.fleet import _write_journal
+
+    _write_journal(tmp_path / "journal.json",
+                   {"format": "igg-fleet-journal-v1", "jobs": {}})
+    assert len(synced) >= 1          # tmp file (+ directory where supported)
+
+    synced.clear()
+    _grid()
+    T = igg.zeros((8, 8, 8)) + 1.0
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000001", T=T)
+    assert len(synced) >= 1          # the manifest seal
+    # And the generation still reads back healthy.
+    assert igg.verify_checkpoint(tmp_path / "gen_000000001")
+
+
+def test_resilience_error_names_its_dump_paths(tmp_path):
+    """Satellite: the exhaustion path's ResilienceError carries the
+    flight-recorder dump path(s) written during auto-dump, named in the
+    message."""
+    _grid()
+    plan = igg.chaos.ChaosPlan(nan_at=[(3, "T")])
+    with pytest.raises(igg.ResilienceError) as ei:
+        igg.run_resilient(_make_step(), _init_state(), 10, watch_every=5,
+                          telemetry=tmp_path, chaos=plan,
+                          install_sigterm=False)
+    err = ei.value
+    assert err.dump_paths == [tmp_path / "flight_r0.json"]
+    assert str(tmp_path / "flight_r0.json") in str(err)
+    # Without a sink there is nothing to name — no paths, clean message.
+    igg.finalize_global_grid()
+    _grid()
+    plan.reset()
+    with pytest.raises(igg.ResilienceError) as ei2:
+        igg.run_resilient(_make_step(), _init_state(), 10, watch_every=5,
+                          chaos=plan, install_sigterm=False)
+    assert ei2.value.dump_paths == []
+    assert "flight recorder" not in str(ei2.value)

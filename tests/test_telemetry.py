@@ -362,10 +362,10 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
             fetches.append(type(x).__name__)
         return real_asarray(x, *a, **kw)
 
-    def run(telemetry, comm=None):
+    def run(telemetry, comm=None, heal=None):
         fetches.clear()
         igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
-                          telemetry=telemetry, comm=comm,
+                          telemetry=telemetry, comm=comm, heal=heal,
                           install_sigterm=False)
         return len(fetches)
 
@@ -411,6 +411,16 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
                                       reps=2)
     with_comm = run(telemetry=tmp_path / "session3", comm=monitor)
     assert with_comm == bare
+    # Round 15: with the HEAL ENGINE enabled too — the detection half is
+    # a bus-subscriber callback, the action half a pending-deque check
+    # per iteration; with no fault present neither touches a device, so
+    # the fetch counts are STILL identical.
+    from igg import heal as iheal
+
+    engine = iheal.HealEngine(iheal.HealPolicy(), run="resilient")
+    with_heal = run(telemetry=tmp_path / "session4", heal=engine)
+    assert with_heal == bare
+    assert engine.actions == [] and not engine.has_pending()
 
 
 # ---------------------------------------------------------------------------
