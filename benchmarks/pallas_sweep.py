@@ -300,6 +300,68 @@ def main():
     })
     igg.finalize_global_grid()
 
+    # Round 16: the two NEW chunk-engine rungs, emitted on EVERY platform
+    # as CONTRACT rows ("pass" = the tier's output matches the XLA
+    # composition within tolerance) — golden-gated via `igg.perf compare`
+    # (benchmarks/goldens/pallas_sweep.jsonl keeps the contract rows;
+    # run_all's GOLDEN_CONTRACT_ONLY filter).  The interpret realizations
+    # run the same admission gates and chunked-exchange structure the
+    # compiled kernels take; the compiled kernels themselves are pinned
+    # by tests/test_mega_tpu.py on hardware.
+    from igg.models import hm3d as _hm
+
+    igg.init_global_grid(16, 16, 128, quiet=True)   # all dims open
+    hp = _hm.Params(lx=4.0, ly=4.0, lz=4.0)
+    hPe, hphi = _hm.init_fields(hp, dtype=np.float32)
+    n5 = 5   # warm-up + one K=4 chunk
+    href = _hm.make_step(hp, donate=False, n_inner=n5, use_pallas=False)
+    htrap = _hm.make_step(hp, donate=False, n_inner=n5, use_pallas=True,
+                          pallas_interpret=True, trapezoid=True, K=4)
+    hr = href(hPe, hphi)
+    ht = htrap(hPe, hphi)
+    hrel = max(
+        float(abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))
+              .max() / (abs(np.asarray(a, np.float64)).max() + 1e-30))
+        for a, b in zip(hr, ht))
+    _, sec = time_steps(lambda Pe, phi: htrap(Pe, phi), (hPe, hphi),
+                        n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "hm3d_trapezoid_open_interpret_K4", "local": 16,
+        "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+        "platform": platform, "rel_vs_composition": hrel,
+        "pass": bool(hrel < 1e-4),
+    })
+    igg.finalize_global_grid()
+
+    from igg.models import wave2d as _w2
+
+    igg.init_global_grid(16, 16, 1, periodx=1, periody=1, quiet=True)
+    wp = _w2.Params()
+    wP, wVx, wVy = _w2.init_fields(wp, dtype=np.float32)
+    wref = _w2.make_step(wp, donate=False, n_inner=n5, use_pallas=False)
+    wr = wref(wP, wVx, wVy)
+    for tag, kw in (("wave2d_mosaic_interpret", dict(chunk=False)),
+                    ("wave2d_chunk_interpret_K4", dict(chunk=True, K=4))):
+        wstep = _w2.make_step(wp, donate=False, n_inner=n5,
+                              use_pallas=True, pallas_interpret=True,
+                              **kw)
+        wo = wstep(wP, wVx, wVy)
+        wrel = max(
+            float(abs(np.asarray(a, np.float64)
+                      - np.asarray(b, np.float64)).max()
+                  / (abs(np.asarray(a, np.float64)).max() + 1e-30))
+            for a, b in zip(wr, wo))
+        _, sec = time_steps(lambda P, Vx, Vy: wstep(P, Vx, Vy),
+                            (wP, wVx, wVy), n1=2, n2=4)
+        emit({
+            "metric": "pallas_sweep_ms_per_step", "config": tag,
+            "local": 16, "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+            "platform": platform, "rel_vs_composition": wrel,
+            "pass": bool(wrel < 1e-4),
+        })
+    igg.finalize_global_grid()
+
 
 if __name__ == "__main__":
     main()

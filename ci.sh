@@ -46,6 +46,23 @@ else
     exit 1
 fi
 
+# Round 16: the two NEW chunk-engine rungs must emit their CPU-smoke
+# CONTRACT rows ("pass" = tier output matches the XLA composition) on
+# every platform — golden-gated via the pallas_sweep goldens in the
+# run_all --compare above (GOLDEN_CONTRACT_ONLY keeps exactly these).
+for cfg in hm3d_trapezoid_open_interpret_K4 wave2d_mosaic_interpret \
+        wave2d_chunk_interpret_K4; do
+    if grep "\"config\": \"$cfg\"" \
+            benchmarks/results_smoke/pallas_sweep.jsonl \
+            | grep -q '"pass": true'; then
+        echo "    $cfg smoke contract row PRESENT and passing"
+    else
+        echo "    $cfg smoke contract row MISSING or failing"
+        echo "    (benchmarks/results_smoke/pallas_sweep.jsonl)"
+        exit 1
+    fi
+done
+
 # Round 8: the resilience tier.  The chaos suite (tests/test_resilience.py:
 # NaN watchdog detection, rollback/retry bit-exactness, checkpoint ring
 # fallback past truncated/bit-flipped generations, preemption + resume,
@@ -293,6 +310,26 @@ else
     echo "    regression gate correctly rejected the injected slowdown"
 fi
 rm -rf "$IGG_GATE_TMP"
+
+# Round 16: autotuned dispatch end to end — cold search in one process
+# (empty ledger seed -> (tier, K, bx) search -> winner <= the hand-picked
+# bx=8 config -> tuning-cache write), then a SECOND process reads the
+# cache and serves the winner with ZERO search dispatches, served config
+# asserted (examples/tuned_run.py asserts all of it internally; the
+# drift->invalidate->eviction leg is test-asserted in
+# tests/test_autotune.py, which ran in the pytest suite above).
+echo "=== autotuned dispatch end to end (cold search -> cache -> second"
+echo "    process serves the winner with zero search dispatches) ==="
+IGG_TUNE_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    IGG_TUNE_CACHE="$IGG_TUNE_TMP/tune.json" \
+    IGG_PERF_LEDGER="$IGG_TUNE_TMP/ledger.json" \
+    python examples/tuned_run.py cold
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    IGG_TUNE_CACHE="$IGG_TUNE_TMP/tune.json" \
+    IGG_PERF_LEDGER="$IGG_TUNE_TMP/ledger.json" \
+    python examples/tuned_run.py warm
+rm -rf "$IGG_TUNE_TMP"
 
 # Compiled-mode TPU kernel tests (VERDICT r3 weak item 4): run
 # unconditionally — the tests' own per-test gate (the single source of

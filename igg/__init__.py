@@ -109,6 +109,7 @@ from .resilience import ResilienceError, RunResult, run_resilient
 from .ensemble import EnsembleResult, run_ensemble
 from .fleet import FleetResult, Job, JobOutcome, run_fleet
 from .timing import time_steps
+from . import autotune
 from . import chaos
 from . import comm
 from . import degrade
@@ -145,6 +146,6 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
-    "telemetry", "Telemetry", "perf", "comm", "heal",
+    "telemetry", "Telemetry", "perf", "comm", "heal", "autotune",
     "time_steps", "__version__",
 ]

@@ -249,3 +249,49 @@ def auto_dispatch(*, use_pallas, interpret, supported_fn, requirement,
 
     dispatch.ladder = ladder
     return dispatch
+
+
+def apply_tuned(family, tune, *, n_inner, interpret, K, chunk_knob,
+                use_pallas):
+    """The chunk-tier families' shared tuned-config application (one
+    implementation of the precedence rules — hm3d/wave2d/stokes3d used
+    to carry private copies):
+
+    - a cached winner's `K` fills an unset caller `K` — and is marked
+      cache-sourced, so the family's `_fit_K` can FALL BACK to auto-fit
+      when that K is inapplicable at this factory's `n_inner` (the cache
+      key has no n_inner axis; only a CALLER-pinned K hard-refuses);
+    - a `<family>.mosaic` winner turns `chunk_knob` "auto" off;
+    - a `<family>.xla` winner pins `use_pallas` off ONLY when the caller
+      left BOTH knobs on auto — an explicit chunk/trapezoid=True always
+      outranks a cached winner.
+
+    Returns `(K, K_from_cache, chunk_knob, use_pallas)`."""
+    from igg import autotune
+
+    tuned = autotune.applied(family, tune, n_inner=n_inner,
+                             interpret=interpret)
+    K_from_cache = False
+    if K is None and tuned and tuned.get("K"):
+        K, K_from_cache = int(tuned["K"]), True
+    if chunk_knob == "auto" and tuned and \
+            tuned.get("tier") == f"{family}.mosaic":
+        chunk_knob = False
+    if use_pallas == "auto" and chunk_knob == "auto" and tuned and \
+            tuned.get("tier") == f"{family}.xla":
+        use_pallas = False
+    return K, K_from_cache, chunk_knob, use_pallas
+
+
+def resolve_chunk_K(K, K_from_cache, supported, fit):
+    """The family `_fit_K` body shared by the chunk tiers: an explicit
+    K serves iff admissible (a caller pin hard-refuses on mismatch, a
+    cache-sourced K falls back to the auto-fit — see
+    :func:`apply_tuned`); otherwise the largest admissible K is
+    fitted."""
+    if K is not None:
+        if supported(K):
+            return K
+        if not K_from_cache:
+            return 0
+    return fit()

@@ -174,7 +174,7 @@ def _best_bx(S0: int) -> int:
 
 def make_step(params: Params = Params(), *, donate: bool = True,
               use_pallas="auto", overlap: bool = False,
-              pallas_interpret: bool = False, verify=None):
+              pallas_interpret: bool = False, verify=None, tune=None):
     """Compiled whole-step function `(T, Cp) -> T` over the grid mesh.
 
     `use_pallas`: "auto" (default) uses the fused Pallas kernel
@@ -192,13 +192,13 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     """
     return make_multi_step(1, params, donate=donate, use_pallas=use_pallas,
                            overlap=overlap, pallas_interpret=pallas_interpret,
-                           verify=verify)
+                           verify=verify, tune=tune)
 
 
 def make_multi_step(n_inner: int, params: Params = Params(), *,
                     donate: bool = True, use_pallas="auto",
                     overlap: bool = False, pallas_interpret: bool = False,
-                    bx: int = None, verify=None):
+                    bx: int = None, verify=None, tune=None):
     """Compiled `(T, Cp) -> T` advancing `n_inner` steps in ONE XLA program
     (`lax.fori_loop` around the step, halo ppermutes included).  This is the
     TPU-idiomatic time loop: host dispatch overhead amortizes to zero, and
@@ -207,8 +207,23 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
     step (`/root/reference/docs/examples/diffusion3D_multigpu_CuArrays_novis.jl:41-48`).
 
     Both paths (fused Pallas kernel / portable XLA) compile through
-    :func:`igg.sharded` into one SPMD program over the grid mesh."""
+    :func:`igg.sharded` into one SPMD program over the grid mesh.
+
+    `tune` consults the autotuner's cached winner for this signature
+    ("auto"/True/False, default the `IGG_TUNE` knob; `igg.autotune`):
+    a hit supplies the slab/chunk depth `bx` and may pin the tier when
+    the caller left the defaults — K is then searched, not fixed."""
     from jax import lax
+
+    from igg import autotune
+
+    tuned = autotune.applied("diffusion3d", tune, n_inner=n_inner,
+                             interpret=pallas_interpret)
+    if bx is None and tuned and tuned.get("bx"):
+        bx = int(tuned["bx"])
+    if use_pallas == "auto" and tuned and \
+            tuned.get("tier") == "diffusion3d.xla":
+        use_pallas = False
 
     dx, dy, dz = params.spacing()
     dt = params.timestep()

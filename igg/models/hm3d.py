@@ -144,10 +144,21 @@ def _pallas_applicable(use_pallas, Pe, interpret: bool = False) -> bool:
                              requirement=_PALLAS_REQ, interpret=interpret)
 
 
+_TRAPEZOID_REQ = (
+    "the K-step HM3D chunk tier requires the fused per-step kernel's "
+    "prerequisites (TPU devices or pallas_interpret=True, overlap-2 "
+    "grid, f32 fields) plus: n_inner >= K+1 (one warm-up step + at "
+    "least one full chunk), band/tile-aligned local shape (x % 8 == 0, "
+    "y % 8 == 0, z % 128 == 0), K-deep send slabs inside every split "
+    "dimension's block, and a VMEM-resident working set for the two "
+    "K-extended fields (igg.ops.hm3d_trapezoid.hm3d_trapezoid_supported)"
+    "; use trapezoid='auto' or the per-step kernel otherwise.")
+
+
 def make_step(params: Params = Params(), *, donate: bool = True,
               overlap: bool = False, n_inner: int = 1,
               use_pallas="auto", pallas_interpret: bool = False,
-              verify=None):
+              trapezoid="auto", K: int = None, verify=None, tune=None):
     """Compiled `(Pe, phi) -> (Pe, phi)` advancing `n_inner` steps in one
     SPMD program.  `use_pallas`: "auto" (default) uses the fused kernel
     (`igg.ops.fused_hm3d_steps`, with boundary-slab carry) when it applies —
@@ -159,7 +170,18 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     satisfies both settings — exactly like diffusion3d.
     `verify`: "first_use" numerically checks the fused tier against the
     XLA composition before it serves traffic (`igg.degrade`; defaults to
-    the `IGG_VERIFY_KERNELS` environment knob)."""
+    the `IGG_VERIFY_KERNELS` environment knob).
+
+    `trapezoid` admits the K-step temporal-blocking chunk tier
+    (`igg.ops.hm3d_trapezoid`, round 16 — generated from the shared
+    chunk engine) on top of the fused kernel: "auto" (default) engages
+    it when `hm3d_trapezoid_supported` admits some K (one warm-up
+    per-step kernel, `(n_inner-1) // K` chunks, the remainder through
+    the per-step kernel); False pins the per-step kernel; True requires
+    the chunk tier and raises `GridError` when inapplicable.  `K`
+    overrides the auto-fitted chunk depth (`fit_hm3d_K`).  `tune`
+    consults the autotuner's cached winner for this signature
+    ("auto"/True/False; `igg.autotune`)."""
     from jax import lax
 
     dx, dy, dz = params.spacing()
@@ -167,6 +189,12 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     phi0, npow, eta = params.phi0, params.npow, params.eta
     # NOTE: the step closures capture only hashable scalars so recreated
     # closures share one compiled program (`igg.parallel._fn_key`).
+
+    from ._dispatch import apply_tuned
+
+    K, K_from_cache, trapezoid, use_pallas = apply_tuned(
+        "hm3d", tune, n_inner=n_inner, interpret=pallas_interpret, K=K,
+        chunk_knob=trapezoid, use_pallas=use_pallas)
 
     def build_xla(assembly):
         def xla_steps(Pe, phi):
@@ -196,16 +224,109 @@ def make_step(params: Params = Params(), *, donate: bool = True,
 
         return pallas_steps
 
+    if trapezoid is True and use_pallas is False:
+        raise igg.GridError(_TRAPEZOID_REQ)
+    if trapezoid is True:
+        use_pallas = True    # the chunk tier rides the fused kernel
+
+    donate_argnums = (0, 1) if donate else ()
+
+    def _fit_K(grid, lshape, dtype):
+        """The chunk depth the trapezoid tier will run (0 when none
+        applies) — shared by the tier's admission gate and its traced
+        body so the two can never disagree."""
+        from igg.ops.hm3d_trapezoid import (fit_hm3d_K,
+                                            hm3d_trapezoid_supported)
+
+        from ._dispatch import resolve_chunk_K
+
+        if trapezoid is False or n_inner < 3:
+            return 0
+        return resolve_chunk_K(
+            K, K_from_cache,
+            lambda k: hm3d_trapezoid_supported(
+                grid, tuple(lshape), k, n_inner - 1, dtype,
+                interpret=pallas_interpret),
+            lambda: fit_hm3d_K(grid, tuple(lshape), n_inner - 1, dtype,
+                               interpret=pallas_interpret))
+
+    def admit_trapezoid(args):
+        from igg.degrade import Admission
+        from igg.ops import hm3d_pallas_supported
+
+        from ._dispatch import pallas_applicable
+
+        if use_pallas is False:
+            return Admission.no("use_pallas=False pins the XLA path")
+        if trapezoid is False:
+            return Admission.no("trapezoid=False pins the per-step "
+                                "kernel")
+        base = pallas_applicable("auto", args[0],
+                                 supported_fn=hm3d_pallas_supported,
+                                 requirement=_PALLAS_REQ,
+                                 interpret=pallas_interpret)
+        if not base:
+            return Admission.no(f"fused per-step kernel (the chunk "
+                                f"tier's carrier) inadmissible: "
+                                f"{getattr(base, 'reason', '')}")
+        if n_inner < 3:
+            return Admission.no(f"n_inner={n_inner} < 3: no warm-up plus "
+                                f"full chunk fits")
+        grid = igg.get_global_grid()
+        Pe = args[0]
+        if not _fit_K(grid, grid.local_shape_any(Pe), Pe.dtype):
+            return Admission.no(
+                "no chunk depth K admissible "
+                "(igg.ops.hm3d_trapezoid.hm3d_trapezoid_supported)")
+        return Admission.yes()
+
+    def build_trapezoid():
+        from igg.ops import fused_hm3d_step
+        from igg.ops.hm3d_trapezoid import fused_hm3d_trapezoid_steps
+
+        def trap_steps(Pe, phi):
+            kw_it = dict(dx=dx, dy=dy, dz=dz, dt=dt, phi0=phi0,
+                         npow=npow, eta=eta)
+            grid = igg.get_global_grid()
+            Kf = _fit_K(grid, Pe.shape, Pe.dtype)
+            if not Kf:    # admission gate and trace share _fit_K
+                raise igg.GridError(_TRAPEZOID_REQ)
+            # Warm-up per-step kernel: consumes (and replaces) the entry
+            # halos exactly like every other path — the exchange-fresh
+            # window state the chunk's validity argument requires, for
+            # ANY input.
+            Pe, phi = fused_hm3d_step(Pe, phi, **kw_it,
+                                      interpret=pallas_interpret)
+            Pe, phi, done = fused_hm3d_trapezoid_steps(
+                Pe, phi, n_inner=n_inner - 1, K=Kf, **kw_it,
+                interpret=pallas_interpret)
+            n = n_inner - 1 - done
+            if n:    # remainder through the per-step kernel
+                Pe, phi = lax.fori_loop(
+                    0, n,
+                    lambda _, S: fused_hm3d_step(
+                        *S, **kw_it, interpret=pallas_interpret),
+                    (Pe, phi))
+            return Pe, phi
+
+        return igg.sharded(trap_steps, donate_argnums=donate_argnums,
+                           check_vma=not pallas_interpret)
+
+    from igg.degrade import Tier
     from igg.ops import hm3d_pallas_supported
 
     from ._dispatch import auto_dispatch
 
+    trap_tier = Tier(name="hm3d.trapezoid", rung=0,
+                     build=build_trapezoid, admit=admit_trapezoid,
+                     required=trapezoid is True,
+                     requirement=_TRAPEZOID_REQ)
     return auto_dispatch(
         use_pallas=use_pallas, interpret=pallas_interpret,
         supported_fn=hm3d_pallas_supported, requirement=_PALLAS_REQ,
         xla_path=xla_path, build_pallas_steps=build_pallas_steps,
-        donate_argnums=(0, 1) if donate else (),
-        family="hm3d", verify=verify)
+        donate_argnums=donate_argnums,
+        family="hm3d", verify=verify, extra_tiers=(trap_tier,))
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,

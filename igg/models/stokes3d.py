@@ -210,7 +210,8 @@ def _pseudo_steps(params: Params):
 def make_iteration(params: Params = Params(), *, donate: bool = True,
                    overlap: bool = False, n_inner: int = 1,
                    use_pallas="auto", pallas_interpret: bool = False,
-                   trapezoid="auto", K: int = None, verify=None):
+                   trapezoid="auto", K: int = None, verify=None,
+                   tune=None):
     """Compiled `(P, Vx, Vy, Vz, Rho) -> (P, Vx, Vy, Vz)` advancing
     `n_inner` iterations in one SPMD program.  `use_pallas`: "auto"
     (default) uses the fused kernel when it applies — TPU devices,
@@ -235,8 +236,17 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     so a quarantined chunk tier falls to the per-iteration kernel and a
     quarantined kernel falls to pure XLA.  `verify="first_use"` (or
     `IGG_VERIFY_KERNELS=1`) numerically checks each fast tier against the
-    truth before it serves traffic."""
+    truth before it serves traffic.  `tune` consults the autotuner's
+    cached winner for this signature ("auto"/True/False, default the
+    `IGG_TUNE` knob; `igg.autotune`): a hit supplies the chunk depth `K`
+    and may pin the tier when the caller left the defaults."""
     from jax import lax
+
+    from ._dispatch import apply_tuned
+
+    K, K_from_cache, trapezoid, use_pallas = apply_tuned(
+        "stokes3d", tune, n_inner=n_inner, interpret=pallas_interpret,
+        K=K, chunk_knob=trapezoid, use_pallas=use_pallas)
 
     kw = _pseudo_steps(params)
     dx, dy, dz = kw["dx"], kw["dy"], kw["dz"]
@@ -278,14 +288,17 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
         from igg.ops.stokes_trapezoid import (fit_stokes_K,
                                               stokes_trapezoid_supported)
 
+        from ._dispatch import resolve_chunk_K
+
         if trapezoid is False or n_inner < 3:
             return 0
-        if K is not None:
-            return K if stokes_trapezoid_supported(
-                grid, tuple(lshape), K, n_inner - 1, dtype,
-                interpret=pallas_interpret) else 0
-        return fit_stokes_K(grid, tuple(lshape), n_inner - 1, dtype,
-                            interpret=pallas_interpret)
+        return resolve_chunk_K(
+            K, K_from_cache,
+            lambda k: stokes_trapezoid_supported(
+                grid, tuple(lshape), k, n_inner - 1, dtype,
+                interpret=pallas_interpret),
+            lambda: fit_stokes_K(grid, tuple(lshape), n_inner - 1, dtype,
+                                 interpret=pallas_interpret))
 
     def admit_trapezoid(args):
         from igg.degrade import Admission
@@ -293,6 +306,12 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
 
         from ._dispatch import pallas_applicable
 
+        if use_pallas is False:
+            # The chunk tier rides the fused kernel: an explicit XLA pin
+            # must reach the truth rung, not a Pallas-backed chunk (the
+            # per-step tiers' probe enforces this for them; round 16
+            # closed the same hole here).
+            return Admission.no("use_pallas=False pins the XLA path")
         if trapezoid is False:
             return Admission.no("trapezoid=False pins the per-iteration "
                                 "kernel")

@@ -79,77 +79,46 @@ In interpret mode (CPU meshes, the driver dryrun) the chunk runs as a
 pure-XLA realization of the same window dynamics (`_window_steps_xla`) —
 the chunked exchange, corner-carrying extensions, and shrinking validity
 are exercised everywhere; only the manual-DMA kernel itself is TPU-only.
+
+Round 16: the family-independent machinery — per-dim window modes, edge
+flags, the slab-permute extension, the open-dim freeze masks, the chunk
+driver, the VMEM budget authority — moved to the shared K-step chunk
+engine (`igg.ops.chunk_engine`, `igg.ops._vmem`); this module keeps the
+diffusion physics, the HBM-streaming ping-pong Mosaic kernel (unique to
+blocks that exceed VMEM), and its admission accounting.  The historical
+underscore names (`_dim_modes`, `_edge_flags`, `_extend_dim`, `_extend`)
+remain importable as engine aliases.
 """
 
 from __future__ import annotations
 
 from functools import partial
 
-from .diffusion_mega import _VMEM_BUDGET
+from ._vmem import chunk_budget
+from .chunk_engine import (central_window, dim_modes, edge_flags,
+                           extend_dim_grouped, extend_fields,
+                           freeze_open_dim, run_chunks, wrap_edges)
 from .diffusion_pallas import _u_rows
 
-
-def _dim_modes(grid, force_y_ext=None, force_z_ext=None):
-    """Per-dimension window mode for the chunk evolution:
-
-      - ``"ext"``    periodic ring, K-extended by ppermute slabs (x is
-                     always extended when periodic — on one device the
-                     self-neighbor slabs are local wrap values);
-      - ``"wrap"``   periodic single device, y/z in-buffer self-wrap;
-      - ``"oext"``   open with >1 devices: extended like "ext" but with
-                     non-wrapping permutes, and the GLOBAL-edge devices
-                     re-freeze their boundary slab every step (the
-                     reference's no-write halo semantics,
-                     `/root/reference/test/test_update_halo.jl:727-732` —
-                     a frozen boundary row is genuinely local, so the
-                     validity front never shrinks from that side);
-      - ``"frozen"`` open single device: no extension, both edge rows
-                     re-frozen every step on every device.
-
-    Both realizations (Mosaic chunk kernel / pure-XLA window) implement
-    all four modes; open dims must be admitted explicitly via
-    `trapezoid_supported(allow_open=True)` (the compiled dispatcher
-    does)."""
-    modes = []
-    for d in range(3):
-        if grid.periods[d]:
-            modes.append("ext" if (d == 0 or grid.dims[d] > 1) else "wrap")
-        else:
-            modes.append("oext" if grid.dims[d] > 1 else "frozen")
-    # The force flags benchmark the (N,M,K) program shapes on a 1-device
-    # self-torus; they only rewire PERIODIC dims (ext <-> wrap) — an open
-    # dim keeps its open mode so the compiled-path gates still reject it
-    # (forcing 'ext' onto an open boundary would silently wrap it).
-    if force_y_ext is not None and grid.periods[1]:
-        modes[1] = "ext" if force_y_ext else "wrap"
-    if force_z_ext is not None and grid.periods[2]:
-        modes[2] = "ext" if force_z_ext else "wrap"
-    return tuple(modes)
+# Engine aliases (the historical private names, still used by tests and
+# benchmarks; the implementations live in `igg.ops.chunk_engine`).
+_dim_modes = dim_modes
+_edge_flags = edge_flags
 
 
-def _edge_flags(modes, grid):
-    """Per-device SMEM edge-flag vector shared by the chunk kernels
-    (diffusion and Stokes): two i32 flags per dim — "frozen" dims
-    statically flag both sides (one device IS both global edges, and no
-    `axis_index` is traced, so 1-device frozen grids still run under
-    plain `jax.jit`), "oext" dims flag the global-edge devices via
-    `axis_index`, periodic dims carry zeros."""
-    import jax.numpy as jnp
-    from jax import lax
+def _extend_dim(T, K, ol, grid, d, mode: str = "ext"):
+    """One field's `size + 2K` window along dim `d` — the single-field
+    form of the engine's grouped slab extension (one ppermute pair of
+    `(K+1)`-row slabs; see `chunk_engine.extend_dim_grouped`)."""
+    return extend_dim_grouped([T], [ol], K, grid, d, mode)[0]
 
-    from ..shared import AXIS_NAMES
 
-    flag_vals = []
-    for d in range(3):
-        if modes[d] == "frozen":
-            flag_vals += [1, 1]
-        elif modes[d] == "oext":
-            ai = lax.axis_index(AXIS_NAMES[d])
-            flag_vals += [(ai == 0).astype(jnp.int32),
-                          (ai == grid.dims[d] - 1).astype(jnp.int32)]
-        else:
-            flag_vals += [0, 0]
-    return jnp.stack([jnp.asarray(v, jnp.int32) for v in flag_vals])
+def _extend(T, K, grid, shape, modes):
+    """Dimension-sequential extension of one field (x, then y OF the
+    x-extended buffer, then z — the sequential-exchange corner trick);
+    wrap/frozen dims are not extended."""
+    ols = [tuple(grid.ol_of_local(d, shape) for d in range(3))]
+    return extend_fields([T], ols, K, grid, modes)[0]
 
 
 def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
@@ -172,16 +141,11 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     import numpy as np
 
     from ..degrade import Admission
+    from .chunk_engine import admit_chunk_common
 
-    if n_inner < bx or bx < 2:
-        return Admission.no(f"n_inner={n_inner} holds no full K={bx} chunk "
-                            f"(needs n_inner >= bx >= 2)")
-    if getattr(grid, "disp", 1) != 1:
-        # The chunked slab exchange hardwires +-1 ppermute tables
-        # (`_extend_dim`); disp > 1 grids take the per-step path, whose
-        # engine-level exchange honors `grid.disp`.
-        return Admission.no(f"grid disp {grid.disp} != 1 (chunk slab "
-                            f"exchange hardwires +-1 ppermute tables)")
+    common = admit_chunk_common(grid, bx, n_inner)
+    if common is not None:
+        return common
     modes = _dim_modes(grid, force_y_ext, force_z_ext)
     if not allow_open and any(m in ("oext", "frozen") for m in modes):
         return Admission.no(f"open (non-periodic) dimensions {modes} and "
@@ -251,9 +215,9 @@ def trapezoid_supported(grid, shape, bx: int, n_inner: int, dtype,
     for d, plane in ((0, S1e * S2e), (1, S0e * S2e), (2, S0e * S1e)):
         if modes[d] in ("oext", "frozen"):
             need += 2 * itemsize * plane
-    if need > _VMEM_BUDGET:
+    if need > chunk_budget():
         return Admission.no(f"resident working set {need} bytes exceeds "
-                            f"the VMEM budget {_VMEM_BUDGET}")
+                            f"the VMEM budget {chunk_budget()}")
     return Admission.yes()
 
 
@@ -469,11 +433,10 @@ def _window_steps_xla(Text, A_ext, *, K, modes, grid, rdx2, rdy2, rdz2):
     buffer — the no-write halo semantics — which both preserves the
     reference's frozen boundary rows bit-for-bit and quarantines the
     garbage in the beyond-domain shoulder rows (a frozen row is never
-    recomputed, so nothing beyond it is ever read by a valid row)."""
-    import jax.numpy as jnp
+    recomputed, so nothing beyond it is ever read by a valid row).  The
+    wrap/freeze primitives are the engine's
+    (`chunk_engine.wrap_edges`/`freeze_open_dim`)."""
     from jax import lax
-
-    from ..shared import AXIS_NAMES
 
     F = Text   # chunk-entry values: the freeze source for open edges
 
@@ -483,26 +446,15 @@ def _window_steps_xla(Text, A_ext, *, K, modes, grid, rdx2, rdy2, rdz2):
             _u_rows(U[:-2], U[1:-1], U[2:], A_ext[1:-1],
                     rdx2=rdx2, rdy2=rdy2, rdz2=rdz2))
         if modes[1] == "wrap":
-            U = U.at[:, 0, 1:-1].set(U[:, S1e - 2, 1:-1])
-            U = U.at[:, S1e - 1, 1:-1].set(U[:, 1, 1:-1])
+            U = wrap_edges(U, 1, S1e, 2)
         if modes[2] == "wrap":
-            U = U.at[:, :, 0].set(U[:, :, S2 - 2])
-            U = U.at[:, :, S2 - 1].set(U[:, :, 1])
+            U = wrap_edges(U, 2, S2, 2)
         for d in range(3):
             Sd = U.shape[d]
-            if modes[d] == "frozen":
-                lo = [slice(None)] * 3
-                hi = [slice(None)] * 3
-                lo[d] = slice(0, 1)
-                hi[d] = slice(Sd - 1, Sd)
-                U = U.at[tuple(lo)].set(F[tuple(lo)])
-                U = U.at[tuple(hi)].set(F[tuple(hi)])
-            elif modes[d] == "oext":
-                idx = lax.broadcasted_iota(jnp.int32, U.shape, d)
-                ai = lax.axis_index(AXIS_NAMES[d])
-                U = jnp.where((ai == 0) & (idx <= K), F, U)
-                U = jnp.where((ai == grid.dims[d] - 1)
-                              & (idx >= Sd - 1 - K), F, U)
+            if modes[d] in ("frozen", "oext"):
+                lo = K if modes[d] == "oext" else 0
+                hi = Sd - 1 - K if modes[d] == "oext" else Sd - 1
+                U = freeze_open_dim(U, F, d, modes[d], lo, hi, grid)
         return U
 
     return lax.fori_loop(0, K, step, Text)
@@ -519,16 +471,13 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
 
     S0e, S1e, S2e = Text.shape
     S0, S1o, S2o = out_shape3
-    extended = [m in ("ext", "oext") for m in modes]
     if interpret:
         out = _window_steps_xla(Text, A_ext, K=K, modes=modes, grid=grid,
                                 rdx2=rdx2, rdy2=rdy2, rdz2=rdz2)
-        for d, (ext, So) in enumerate(zip(extended, (S0, S1o, S2o))):
-            if ext:
-                out = lax.slice_in_dim(out, K, K + So, axis=d)
-        return out
+        return central_window(out, out_shape3, K, modes)
     import jax.numpy as jnp
 
+    extended = [m in ("ext", "oext") for m in modes]
     y_ext, z_ext = extended[1], extended[2]
     if z_ext and S2e % 128 != 0:
         # Mosaic requires 128-aligned VMEM lane slices; right-pad the
@@ -613,85 +562,6 @@ def _chunk_call(Text, A_ext, out_shape3, *, K, bx, modes, grid,
     return out
 
 
-def _extend_dim(T, K, ol, grid, d, mode: str = "ext"):
-    """The `size + 2K` contiguous global window along dim `d`: K extension
-    rows beyond each end PLUS neighbor-fresh values for the block's own
-    halo rows, all from one ppermute pair of `(K+1)`-row slabs
-    (self-neighbor on a 1-device ring).
-
-    Replacing the local halo rows with the neighbors' send-position rows
-    makes the window exchange-fresh at chunk entry — the invariant the
-    trapezoidal validity argument needs.  When the entry halos are already
-    fresh (any state produced by `update_halo`, a model step, or a previous
-    chunk) the replacement is a bit-exact no-op.
-
-    z slabs (`d == 2`) ride the wire TRANSPOSED — `(S0, K+1, S1)` with z on
-    the sublane axis — because a materialized `(S0, S1, K+1)` array is
-    lane-padded to 128 (~14x its logical HBM footprint at K=8); the
-    transpose back into the lane-dim concatenate stays inside one XLA
-    fusion, so nothing lane-padded reaches HBM or the ICI links."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    from ..shared import AXIS_NAMES
-
-    S = T.shape[d]
-    n = grid.dims[d]
-    axis = AXIS_NAMES[d]
-    open_edges = mode == "oext"
-    # rows [S-ol-K, S-ol]: K extension rows + the halo value for the
-    # next neighbor's row 0; rows [ol-1, ol+K): ditto mirrored.
-    left_slab = lax.slice_in_dim(T, S - ol - K, S - ol + 1, axis=d)
-    right_slab = lax.slice_in_dim(T, ol - 1, ol + K, axis=d)
-    if n > 1:
-        if open_edges:
-            to_right = [(i, i + 1) for i in range(n - 1)]
-            to_left = [(i, i - 1) for i in range(1, n)]
-        else:
-            to_right = [(i, (i + 1) % n) for i in range(n)]
-            to_left = [(i, (i - 1) % n) for i in range(n)]
-        tw = d == 2 and T.ndim == 3   # transpose-carried lane-dim slabs
-        if tw:
-            left_slab = jnp.swapaxes(left_slab, 1, 2)
-            right_slab = jnp.swapaxes(right_slab, 1, 2)
-        left_slab = lax.ppermute(left_slab, axis, to_right)
-        right_slab = lax.ppermute(right_slab, axis, to_left)
-        if tw:
-            left_slab = jnp.swapaxes(left_slab, 1, 2)
-            right_slab = jnp.swapaxes(right_slab, 1, 2)
-    Text = jnp.concatenate(
-        [left_slab, lax.slice_in_dim(T, 1, S - 1, axis=d), right_slab],
-        axis=d)
-    if open_edges:
-        # Global-edge devices received zeros: rows [0, K) / [Se-K, Se) lie
-        # beyond the domain (garbage the step-level freeze quarantines),
-        # but ext row K / Se-1-K replaced the block's own boundary rows —
-        # restore their no-write (stale) values there.
-        idx = lax.axis_index(axis)
-        Se = S + 2 * K
-        fixed_l = lax.dynamic_update_slice_in_dim(
-            Text, lax.slice_in_dim(T, 0, 1, axis=d), K, axis=d)
-        Text = jnp.where(idx == 0, fixed_l, Text)
-        fixed_r = lax.dynamic_update_slice_in_dim(
-            Text, lax.slice_in_dim(T, S - 1, S, axis=d), Se - 1 - K, axis=d)
-        Text = jnp.where(idx == n - 1, fixed_r, Text)
-    return Text
-
-
-def _extend(T, K, grid, shape, modes):
-    """x extension, then (for split y/z) the y extension OF the x-extended
-    buffer and the z extension of the x/y-extended buffer — corner and edge
-    regions arrive via the later neighbors' own earlier-dim extensions (the
-    sequential-exchange corner trick).  "wrap"/"frozen" dims are not
-    extended (in-buffer self-wrap / frozen edges)."""
-    Text = T
-    for d in range(3):
-        if modes[d] in ("ext", "oext"):
-            Text = _extend_dim(Text, K, grid.ol_of_local(d, shape), grid,
-                               d, modes[d])
-    return Text
-
-
 def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
                                     grid, rdx2, rdy2, rdz2,
                                     force_y_ext=None, force_z_ext=None,
@@ -701,19 +571,16 @@ def fused_diffusion_trapezoid_steps(T, A, *, n_inner: int, bx: int,
     only the `n_inner // bx` full chunks and returns `(T, steps_done)`).
     `force_y_ext`/`force_z_ext` override the mesh-derived modes
     (benchmarking the `(N,M,K)` program shapes on a 1-device self-torus)."""
-    from jax import lax
-
     K = bx
     shape = T.shape
     modes = _dim_modes(grid, force_y_ext, force_z_ext)
-    chunks = n_inner // K
     A_ext = _extend(A, K, grid, shape, modes)   # loop-invariant
 
-    def one(_, T):
+    def one(T):
         Text = _extend(T, K, grid, shape, modes)
-        return _chunk_call(Text, A_ext, shape, K=K, bx=bx, modes=modes,
-                           grid=grid, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
-                           interpret=interpret)
+        return (_chunk_call(Text, A_ext, shape, K=K, bx=bx, modes=modes,
+                            grid=grid, rdx2=rdx2, rdy2=rdy2, rdz2=rdz2,
+                            interpret=interpret),)
 
-    T = lax.fori_loop(0, chunks, one, T)
-    return T, chunks * K
+    T, done = run_chunks((T,), n_inner=n_inner, K=K, one_chunk=one)
+    return T, done

@@ -5,18 +5,15 @@ pseudo-transient solver.
 (`stokes_pallas`, 0.143 ms/iter at 128^3 f32) is jointly DMA- and
 VPU-bound with a ~2.1x-over-composition ceiling, and names the only
 escape: temporal blocking.  This module is that escape — the four-field,
-staggered-shapes instance of the `diffusion_trapezoid` recipe:
+staggered-shapes instance of the shared K-step chunk engine
+(`igg.ops.chunk_engine`):
 
   1. Once per K-iteration chunk, each device extends its block by
      `E = 2K` margin rows per extended dimension via ONE grouped
      `ppermute` pair per dimension per shape group (P and Vx share x-slab
      shapes and ride one permute, exactly like their shared plane shapes
      in `stokes_pallas`; Rho's loop-invariant extension is hoisted out of
-     the chunk loop entirely).  The extensions are built
-     dimension-sequentially — y slabs are cut from the x-extended buffer
-     — so corner/edge regions arrive via the later neighbors' own
-     earlier-dim extensions (the halo engine's sequential-exchange corner
-     trick, `/root/reference/src/update_halo.jl:36,130`).
+     the chunk loop entirely) — `chunk_engine.extend_fields`.
   2. K coupled iterations run on the extended windows with NO exchange:
      per iteration the windows lose (at most) 2 rows of validity per
      extended side — the pseudo-transient chain (pressure update read by
@@ -44,35 +41,36 @@ Two realizations of the same window dynamics:
 
   - **Pure-XLA window path** (`_window_iters_xla`) — interpret mode, CPU
     meshes, the driver dryrun: `iteration_core` + `interior_add` on the
-    full extended window per iteration, shoulder-band freezing on open
-    dims.  This is the realization the 8-device mesh equivalence tests
-    pin against `stokes3d.local_iteration`.
-  - **Mosaic chunk kernel** (`_kernel`) — compiled mode: all five fields
-    VMEM-RESIDENT for the whole chunk (grid `(K, nb)`, "arbitrary"
-    semantics), updated IN PLACE in x-row bands with a one-row lag
-    buffer carrying each band's overwritten tail row to its successor
-    (margin-1 windows, the per-iteration kernel's proven margins).  HBM
+    full extended window per iteration through the engine's generic
+    per-dim halo loop (`chunk_engine.window_chunk_xla`, velocities
+    frozen on open dims).  This is the realization the 8-device mesh
+    equivalence tests pin against `stokes3d.local_iteration`.
+  - **Mosaic chunk kernel** — compiled mode: the engine's generic
+    VMEM-resident banded kernel (`chunk_engine.resident_chunk_call`),
+    instantiated with this family's config — five fields resident for
+    the whole chunk (grid `(K, nb)`, "arbitrary" semantics), updated IN
+    PLACE in x-row bands with a one-row lag buffer carrying each band's
+    overwritten tail row to its successor (margin-1 windows, Vx's
+    x-staggered high margin 2 — the per-iteration kernel's proven
+    margins), velocities (fields 1-3) freeze-gated on open dims.  HBM
     traffic per chunk is ONE read of the five extended fields and ONE
     write of the four updated ones — `(5R+4W)/K` per iteration instead
     of the per-iteration kernel's `5R+4W`, the 1/K amortization the
-    roofline demands.  Unlike the diffusion trapezoid (whose blocks
-    exceed VMEM and stream through HBM ping-pong buffers), the Stokes
-    working set at its VMEM-admissible sizes (~<=160^3 f32 locals) fits
-    on chip, so the kernel needs no ping-pong: the only DMAs are the
-    chunk-entry loads and the final-iteration band write-backs (the
-    staggered Vy/Vz trailing dims ride tile-padded so every leading-dim
-    VMEM slice stays aligned; the band compute slices the logical region
-    back out as values).  `_band_update` — the shared per-band value
+    roofline demands.  `_band_update` — the shared per-band value
     computation — keeps `stokes3d.iteration_core` the single source of
     arithmetic truth, and is pinned against the window realization by
-    the banded-scheme simulation in `tests/test_stokes_trapezoid.py`.
+    the banded-scheme simulation in `tests/test_stokes_trapezoid.py`;
+    the compiled instantiation is pinned on hardware by
+    `tests/test_mega_tpu.py::test_stokes_trapezoid_matches_per_iteration`.
 
 VMEM is the K-bound: the resident working set grows with `K` through the
 `2K`-row extensions (plus the Vz lane padding the roofline documents), so
-`stokes_trapezoid_supported` does the accounting and `fit_stokes_K` picks
-the largest admissible K — at 128^3 f32 on an `(N,1,1)` mesh that is
-K=8 (~70 MB modeled; K=16 would need the 2x-margin model past the
-110 MB budget).  `docs/stokes_roofline.md` carries the full analysis.
+`stokes_trapezoid_supported` does the accounting against the shared
+budget authority (`igg.ops._vmem.chunk_budget`) and `fit_stokes_K`
+(`_vmem.fit_chunk_K`) picks the largest admissible K — at 128^3 f32 on
+an `(N,1,1)` mesh that is K=8 (~70 MB modeled; K=16 would need the
+2x-margin model past the 110 MB budget).  `docs/stokes_roofline.md`
+carries the full analysis.
 
 The compiled dispatcher (`stokes3d.make_iteration`) runs one per-iteration
 fused kernel FIRST — consuming (and replacing) the entry halos exactly
@@ -85,18 +83,19 @@ from __future__ import annotations
 
 from functools import partial
 
-from .diffusion_mega import _VMEM_BUDGET
-from .diffusion_trapezoid import _dim_modes
+from ._vmem import chunk_budget, fit_chunk_K
+from .chunk_engine import (admit_chunk_common, admit_send_slabs, band_halo,
+                           dim_modes as _dim_modes, ext_shape as _ext_shape_e,
+                           extend_dim_grouped, extend_fields, field_ols,
+                           pad8 as _pad8, pad128 as _pad128,
+                           resident_chunk_call, run_chunks,
+                           window_chunk_xla, wrap_edges as _wrap_edges)
 
 _BX = 8          # x band height of the chunk kernel (rows per program)
 
-
-def _pad8(v: int) -> int:
-    return -(-v // 8) * 8
-
-
-def _pad128(v: int) -> int:
-    return -(-v // 128) * 128
+# Engine aliases (historical private names, still used by tests/benchmarks).
+_extend_dim_grouped = extend_dim_grouped
+_extend_fields = extend_fields
 
 
 def _field_shapes(shape):
@@ -109,12 +108,11 @@ def _field_shapes(shape):
 def _ols(grid, shapes):
     """Per-field per-dim staggered overlaps (`ol(dim, A)`,
     `/root/reference/src/shared.jl:81`)."""
-    return [tuple(grid.ol_of_local(d, s) for d in range(3)) for s in shapes]
+    return field_ols(grid, shapes)
 
 
 def _ext_shape(s, E, modes):
-    return tuple(s[d] + (2 * E if modes[d] in ("ext", "oext") else 0)
-                 for d in range(3))
+    return _ext_shape_e(s, E, modes)
 
 
 def _vmem_need(shape, K, modes, itemsize: int = 4) -> int:
@@ -156,18 +154,14 @@ def stokes_trapezoid_supported(grid, shape, K: int, n_inner: int, dtype,
 
     from ..degrade import Admission
 
-    if K < 2 or n_inner < K:
-        return Admission.no(f"n_inner={n_inner} holds no full K={K} chunk "
-                            f"(needs n_inner >= K >= 2)")
+    common = admit_chunk_common(grid, K, n_inner)
+    if common is not None:
+        return common
     if grid.overlaps != (3, 3, 3):
         return Admission.no(f"grid overlaps {grid.overlaps} != (3, 3, 3)")
     if tuple(shape) != tuple(grid.nxyz):
         return Admission.no(f"local shape {tuple(shape)} != grid block "
                             f"{tuple(grid.nxyz)}")
-    if getattr(grid, "disp", 1) != 1:
-        # The chunked slab exchange hardwires +-1 ppermute tables.
-        return Admission.no(f"grid disp {grid.disp} != 1 (chunk slab "
-                            f"exchange hardwires +-1 ppermute tables)")
     if np.dtype(dtype) != np.float32:
         return Admission.no(f"dtype {np.dtype(dtype)} is not float32")
     modes = _dim_modes(grid)
@@ -191,123 +185,25 @@ def stokes_trapezoid_supported(grid, shape, K: int, n_inner: int, dtype,
                             f"(E % 8 != 0)")
     shapes = _field_shapes(shape)
     ols = _ols(grid, shapes)
-    for d in range(3):
-        if modes[d] not in ("ext", "oext"):
-            continue
-        for s, ol in zip(shapes, ols):
-            if s[d] - ol[d] - E < 0 or ol[d] + E > s[d]:
-                # K-deep send slabs inside the block
-                return Admission.no(
-                    f"E={E} dim-{d} send slabs fall outside a field block "
-                    f"(shape {s}, ol {ol[d]})")
+    slabs = admit_send_slabs(shapes, ols, E, modes)
+    if slabs is not None:
+        return slabs
     need = _vmem_need(shape, K, modes)
-    if need > _VMEM_BUDGET:
+    if need > chunk_budget():
         return Admission.no(f"resident working set {need} bytes exceeds "
-                            f"the VMEM budget {_VMEM_BUDGET}")
+                            f"the VMEM budget {chunk_budget()}")
     return Admission.yes()
 
 
 def fit_stokes_K(grid, shape, n_inner: int, dtype,
                  interpret: bool = False, kmax: int = 8) -> int:
-    """Largest admissible chunk depth K <= kmax (halving, >= 2); 0 when
-    none applies.  Even K keeps `S0e = S0 + 4K` band-divisible on
-    extended-x meshes."""
-    K = kmax
-    while K >= 2:
-        if stokes_trapezoid_supported(grid, shape, K, n_inner, dtype,
-                                      interpret=interpret):
-            return K
-        K //= 2
-    return 0
-
-
-# ---------------------------------------------------------------------------
-# Extension: grouped K-deep slab ppermutes, dimension-sequential
-# ---------------------------------------------------------------------------
-
-def _extend_dim_grouped(arrs, ols, E, grid, d, mode):
-    """`_extend_dim` of `diffusion_trapezoid`, generalized to a GROUP of
-    fields with per-field staggered overlaps: same-shaped slabs are
-    stacked and ride ONE ppermute per direction (P and Vx share x-slab
-    shapes; Vy/Vz are staggered-shaped and go alone), the direct analog
-    of the halo engine's grouped plane wire.  z slabs ride TRANSPOSED
-    (z on the sublane axis) so nothing lane-padded materializes."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    from ..shared import AXIS_NAMES
-
-    n = grid.dims[d]
-    axis = AXIS_NAMES[d]
-    open_edges = mode == "oext"
-    tw = d == 2                      # transpose-carried lane-dim slabs
-
-    slabs = []
-    for A, ol in zip(arrs, ols):
-        S = A.shape[d]
-        left = lax.slice_in_dim(A, S - ol - E, S - ol + 1, axis=d)
-        right = lax.slice_in_dim(A, ol - 1, ol + E, axis=d)
-        if tw:
-            left, right = (jnp.swapaxes(x, 1, 2) for x in (left, right))
-        slabs.append([left, right])
-
-    if n > 1:
-        if open_edges:
-            to_right = [(i, i + 1) for i in range(n - 1)]
-            to_left = [(i, i - 1) for i in range(1, n)]
-        else:
-            to_right = [(i, (i + 1) % n) for i in range(n)]
-            to_left = [(i, (i - 1) % n) for i in range(n)]
-        groups = {}
-        for j, (left, right) in enumerate(slabs):
-            groups.setdefault(tuple(left.shape), []).append(j)
-        for members in groups.values():
-            for side, table in ((0, to_right), (1, to_left)):
-                if len(members) == 1:
-                    j = members[0]
-                    slabs[j][side] = lax.ppermute(slabs[j][side], axis,
-                                                  table)
-                else:
-                    stacked = jnp.stack([slabs[j][side] for j in members])
-                    stacked = lax.ppermute(stacked, axis, table)
-                    for k, j in enumerate(members):
-                        slabs[j][side] = stacked[k]
-
-    out = []
-    for A, ol, (left, right) in zip(arrs, ols, slabs):
-        if tw:
-            left, right = (jnp.swapaxes(x, 1, 2) for x in (left, right))
-        S = A.shape[d]
-        Text = jnp.concatenate(
-            [left, lax.slice_in_dim(A, 1, S - 1, axis=d), right], axis=d)
-        if open_edges:
-            # Global-edge devices received zeros; restore the block's own
-            # no-write boundary rows at ext index E / Se-1-E (the
-            # beyond-domain shoulder stays garbage the freeze quarantines).
-            idx = lax.axis_index(axis)
-            Se = S + 2 * E
-            fixed_l = lax.dynamic_update_slice_in_dim(
-                Text, lax.slice_in_dim(A, 0, 1, axis=d), E, axis=d)
-            Text = jnp.where(idx == 0, fixed_l, Text)
-            fixed_r = lax.dynamic_update_slice_in_dim(
-                Text, lax.slice_in_dim(A, S - 1, S, axis=d), Se - 1 - E,
-                axis=d)
-            Text = jnp.where(idx == n - 1, fixed_r, Text)
-        out.append(Text)
-    return out
-
-
-def _extend_fields(arrs, ols, E, grid, modes):
-    """Dimension-sequential extension of a list of fields: x first, then
-    the y extension OF the x-extended buffers, then z of the x/y-extended
-    — the sequential-exchange corner trick.  wrap/frozen dims are not
-    extended."""
-    out = list(arrs)
-    for d in range(3):
-        if modes[d] in ("ext", "oext"):
-            out = _extend_dim_grouped(out, [ol[d] for ol in ols], E, grid,
-                                      d, modes[d])
-    return out
+    """Largest admissible chunk depth K <= kmax (halving, >= 2;
+    `_vmem.fit_chunk_K`); 0 when none applies.  Even K keeps
+    `S0e = S0 + 4K` band-divisible on extended-x meshes."""
+    return fit_chunk_K(
+        lambda K: stokes_trapezoid_supported(grid, tuple(shape), K, n_inner,
+                                             dtype, interpret=interpret),
+        kmax)
 
 
 # ---------------------------------------------------------------------------
@@ -340,63 +236,13 @@ def _band_update(Wp, Wvx, Wvy, Wvz, Wrho, *, bx, scal):
 
 
 def _band_halo(news, a, bx, flags, frx, fryz, cfg):
-    """Per-band halo handling of the four new-band value arrays, in
-    dimension order (later dims win shared cells, the per-iteration
-    path's assembly order): x freeze rows (open dims, velocities only),
-    then y wrap/freeze, then z wrap/freeze.  `flags` is the 6-vector of
-    edge flags as VALUES (SMEM scalars in the kernel, python ints in the
-    simulation); `frx[(f, side)]` are whole x freeze planes and
-    `fryz[(f, d, side)]` the band-sliced y/z freeze rows of velocity
-    field f (logical trailing extents).  Pure values — shared by the
-    Mosaic kernel and the banded-scheme simulation test."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    modes, ols, ext_shapes, E = (cfg["modes"], cfg["ols"],
-                                 cfg["ext_shapes"], cfg["E"])
-    news = list(news)
-
-    if modes[0] in ("oext", "frozen"):
-        lo = E if modes[0] == "oext" else 0
-        for f in (1, 2, 3):
-            hi = lo + cfg["shapes"][f][0] - 1
-            rows = lax.broadcasted_iota(jnp.int32, news[f].shape, 0) + a
-            news[f] = jnp.where((rows == lo) & (flags[0] == 1),
-                                frx[(f, 0)][None], news[f])
-            news[f] = jnp.where((rows == hi) & (flags[1] == 1),
-                                frx[(f, 1)][None], news[f])
-    for d in (1, 2):
-        if modes[d] == "wrap":
-            for f in range(4):
-                sd = ext_shapes[f][d]
-                ol = ols[f][d]
-                news[f] = _wrap_edges(news[f], d, sd, ol)
-        elif modes[d] in ("oext", "frozen"):
-            lo = E if modes[d] == "oext" else 0
-            for f in (1, 2, 3):
-                hi = lo + cfg["shapes"][f][d] - 1
-                idx = lax.broadcasted_iota(jnp.int32, news[f].shape, d)
-                exp = (lambda P: jnp.expand_dims(P, d))
-                news[f] = jnp.where((idx == lo) & (flags[2 * d] == 1),
-                                    exp(fryz[(f, d, 0)]), news[f])
-                news[f] = jnp.where((idx == hi) & (flags[2 * d + 1] == 1),
-                                    exp(fryz[(f, d, 1)]), news[f])
-    return tuple(news)
-
-
-def _wrap_edges(v, axis, size, ol):
-    """Per-field staggered periodic self-wrap of the outermost planes
-    along `axis`: edge 0 <- inner `size-ol`, edge `size-1` <- inner
-    `ol-1` (`/root/reference/src/update_halo.jl:516-532`)."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    idx = lax.broadcasted_iota(jnp.int32, v.shape, axis)
-    v = jnp.where(idx == 0,
-                  lax.slice_in_dim(v, size - ol, size - ol + 1, axis=axis),
-                  v)
-    return jnp.where(idx == size - 1,
-                     lax.slice_in_dim(v, ol - 1, ol, axis=axis), v)
+    """Per-band halo handling of the four new-band value arrays — the
+    engine's generic `chunk_engine.band_halo` with this family's freeze
+    set (the three velocities).  Kept as the historical entry point for
+    the banded-scheme simulation test."""
+    cfg = dict(cfg)
+    cfg.setdefault("freeze_fields", (1, 2, 3))
+    return band_halo(news, a, bx, flags, frx, fryz, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -406,324 +252,56 @@ def _wrap_edges(v, axis, size, ol):
 def _window_iters_xla(Pe, Vxe, Vye, Vze, Rhoe, *, K, E, modes, grid, scal,
                       ols, shapes):
     """K coupled iterations on the extended windows: full-window
-    `iteration_core` + `interior_add`, then per-dim halo handling in
-    dimension order — wrap dims self-wrap with per-field staggered ol;
-    open dims re-freeze the VELOCITY shoulder+boundary band from the
-    chunk-entry buffers on the global-edge devices (pressure is computed
-    everywhere, its boundary value being the per-iteration path's
-    computed no-write plane).  The freeze width differs from the Mosaic
-    kernel (whole shoulder band vs exactly the boundary plane); the two
-    agree on the central window because influence from the shoulder can
-    only pass THROUGH the frozen boundary plane, which never reads it
-    (the diffusion chunk kernel's quarantine argument, radius checked
-    for the coupled chain in `docs/stokes_roofline.md`)."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    from ..shared import AXIS_NAMES
+    `iteration_core` + `interior_add`, then the engine's per-dim halo
+    handling in dimension order — wrap dims self-wrap with per-field
+    staggered ol; open dims re-freeze the VELOCITY shoulder+boundary band
+    from the chunk-entry buffers on the global-edge devices (pressure is
+    computed everywhere, its boundary value being the per-iteration
+    path's computed no-write plane).  The freeze width differs from the
+    Mosaic kernel (whole shoulder band vs exactly the boundary plane);
+    the two agree on the central window because influence from the
+    shoulder can only pass THROUGH the frozen boundary plane, which
+    never reads it (the diffusion chunk kernel's quarantine argument,
+    radius checked for the coupled chain in `docs/stokes_roofline.md`)."""
     from .stencil import interior_add
 
-    entry = (Pe, Vxe, Vye, Vze)       # freeze source for open edges
-
-    def step(_, S):
-        P, Vx, Vy, Vz = S
+    def core(P, Vx, Vy, Vz):
         from ..models.stokes3d import iteration_core
 
         P, dVx, dVy, dVz = iteration_core(P, Vx, Vy, Vz, Rhoe, **scal)
-        Vx = interior_add(Vx, dVx)
-        Vy = interior_add(Vy, dVy)
-        Vz = interior_add(Vz, dVz)
-        fields = [P, Vx, Vy, Vz]
-        for d in range(3):
-            if modes[d] == "wrap":
-                for f in range(4):
-                    sd = fields[f].shape[d]
-                    fields[f] = _wrap_edges(fields[f], d, sd, ols[f][d])
-            elif modes[d] in ("oext", "frozen"):
-                lo = E if modes[d] == "oext" else 0
-                for f in (1, 2, 3):      # velocities only; P is computed
-                    F0 = entry[f]
-                    sd = shapes[f][d]
-                    hi = lo + sd - 1
-                    idx = lax.broadcasted_iota(jnp.int32, fields[f].shape,
-                                               d)
-                    if modes[d] == "frozen":
-                        keep = (idx == lo) | (idx == hi)
-                        fields[f] = jnp.where(keep, F0, fields[f])
-                    else:
-                        ai = lax.axis_index(AXIS_NAMES[d])
-                        n = grid.dims[d]
-                        fields[f] = jnp.where((ai == 0) & (idx <= lo), F0,
-                                              fields[f])
-                        fields[f] = jnp.where((ai == n - 1) & (idx >= hi),
-                                              F0, fields[f])
-        return tuple(fields)
+        return (P, interior_add(Vx, dVx), interior_add(Vy, dVy),
+                interior_add(Vz, dVz))
 
-    return lax.fori_loop(0, K, step, (Pe, Vxe, Vye, Vze))
+    return window_chunk_xla((Pe, Vxe, Vye, Vze), K=K, E=E, modes=modes,
+                            grid=grid, ols=ols, shapes=shapes,
+                            freeze_fields=(1, 2, 3), core=core)
 
 
 # ---------------------------------------------------------------------------
-# The Mosaic chunk kernel (compiled mode): VMEM-resident in-place bands
+# The Mosaic chunk realization: the engine's generic resident banded kernel
 # ---------------------------------------------------------------------------
-
-def _kernel(*refs, K, bx, scal, cfg, nfr, pads):
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    shapes = cfg["shapes"]            # local (unextended) field shapes
-    ext_shapes = cfg["ext_shapes"]    # logical extended shapes
-    modes = cfg["modes"]
-
-    it = iter(refs)
-    text_hbm = [next(it) for _ in range(5)]       # P,Vx,Vy,Vz,Rho (padded)
-    flags_ref = next(it) if nfr else None         # SMEM (6,) i32
-    fr_hbm = [next(it) for _ in range(nfr)]       # padded freeze planes
-    outs = [next(it) for _ in range(4)]           # aliased to text inputs
-    fv = [next(it) for _ in range(5)]             # resident field scratch
-    lag = [next(it) for _ in range(4)]            # (2, 1, S1p, S2p)-ish
-    fr_v = [next(it) for _ in range(nfr)]
-    lsem = next(it)
-    osem = next(it)
-    fsem = next(it) if nfr else None
-
-    k = pl.program_id(0)
-    i = pl.program_id(1)
-    a = i * bx
-    sl = i % 2
-
-    # One-time chunk-entry load: the five padded extended fields (and the
-    # freeze planes) HBM -> VMEM.  Synchronous — once per K iterations.
-    @pl.when((k == 0) & (i == 0))
-    def _():
-        cs = [pltpu.make_async_copy(text_hbm[j], fv[j], lsem.at[j])
-              for j in range(5)]
-        for c in cs:
-            c.start()
-        for c in cs:
-            c.wait()
-
-    if nfr:
-        @pl.when((k == 0) & (i == 0))
-        def _():
-            cs = [pltpu.make_async_copy(fr_hbm[j], fr_v[j], fsem.at[j])
-                  for j in range(nfr)]
-            for c in cs:
-                c.start()
-            for c in cs:
-                c.wait()
-
-    # Band 0 has no predecessor: seed its low-margin lag slot with the
-    # clamped duplicate of row 0 (the dup feeds only the band's outermost
-    # V rows — shoulder garbage / frozen; the pressure rows never read
-    # it, see the module docstring).
-    @pl.when(i == 0)
-    def _():
-        for f in range(4):
-            lag_w = lag[f].at[pl.ds(1, 1)]
-            lag_w[:] = fv[f][pl.ds(0, 1)]
-
-    # Save this band's tail row (about to be overwritten) for the next
-    # band's low margin — VMEM-to-VMEM, one row per field, slot-alternated
-    # (band i writes slot i%2, band i+1 reads it back as 1-(i+1)%2; band
-    # 0 reads the seed above from the same uniform expression).
-    for f in range(4):
-        lag_w = lag[f].at[pl.ds(sl, 1)]
-        lag_w[:] = fv[f][pl.ds(a + bx - 1, 1)]
-
-    # Margin-1 windows.  Low margin: row a-1 — band i-1 already overwrote
-    # it, so every band reads its lag slot.  High margins clamp at the
-    # buffer end (top-band dups feed only shoulder/frozen V rows — the
-    # pressure rows read real rows everywhere, module docstring).
-    nrows = [ext_shapes[f][0] for f in range(5)]
-
-    def window(f, extra):
-        if f < 4:
-            m1 = lag[f][pl.ds(1 - sl, 1)]
-        else:
-            m1 = fv[f][pl.ds(jnp.maximum(a - 1, 0), 1)]   # Rho: never
-            # overwritten, clamped margin read straight from the buffer
-        parts = [m1, fv[f][pl.ds(a, bx)]]
-        top = nrows[f] - 1
-        for e in range(1, extra + 1):
-            parts.append(fv[f][pl.ds(jnp.minimum(a + bx + e - 1, top), 1)])
-        return jnp.concatenate(parts, axis=0)
-
-    def logical(W, f):
-        # Slice the tile-padded trailing extents back to the field's
-        # logical extended shape (values; Mosaic masks the lanes).
-        return W[:, :ext_shapes[f][1], :ext_shapes[f][2]]
-
-    Wp = logical(window(0, 1), 0)
-    Wvx = logical(window(1, 2), 1)
-    Wvy = logical(window(2, 1), 2)
-    Wvz = logical(window(3, 1), 3)
-    Wrho = logical(window(4, 1), 4)
-
-    news = _band_update(Wp, Wvx, Wvy, Wvz, Wrho, bx=bx, scal=scal)
-
-    # Halo handling on the new band values (freeze planes band-sliced to
-    # logical extents; SMEM flags read as scalars).
-    flags = ([flags_ref[j] for j in range(6)] if nfr else [0] * 6)
-    frx, fryz = {}, {}
-    j = 0
-    for d in range(3):
-        if modes[d] not in ("oext", "frozen"):
-            continue
-        for f in (1, 2, 3):
-            pl_shape = [ext_shapes[f][x] for x in range(3) if x != d]
-            for side in (0, 1):
-                if d == 0:
-                    frx[(f, side)] = fr_v[j][...][:pl_shape[0],
-                                                  :pl_shape[1]]
-                else:
-                    fryz[(f, d, side)] = fr_v[j][pl.ds(a, bx)][
-                        :, :pl_shape[1]]
-                j += 1
-    news = _band_halo(news, a, bx, flags, frx, fryz, cfg)
-
-    # In-place write, padded back with the old trailing columns.
-    for f in range(4):
-        new = news[f]
-        pady, padz = pads[f]
-        old = fv[f][pl.ds(a, bx)]
-        if padz:
-            new = jnp.concatenate([new, old[:, :new.shape[1], -padz:]],
-                                  axis=2)
-        if pady:
-            new = jnp.concatenate([new, old[:, -pady:, :]], axis=1)
-        fv[f][pl.ds(a, bx)] = new
-
-    # Final iteration: band write-back to the (aliased) outputs.
-    # Synchronous — once per chunk; rows outside the band grid (Vx's top
-    # face) keep their aliased entry values, exactly the frozen/no-write
-    # semantics they need.
-    @pl.when(k == K - 1)
-    def _():
-        cs = [pltpu.make_async_copy(fv[f].at[pl.ds(a, bx)],
-                                    outs[f].at[pl.ds(a, bx)], osem.at[f])
-              for f in range(4)]
-        for c in cs:
-            c.start()
-        for c in cs:
-            c.wait()
-
 
 def _chunk_call(exts, Rho_ext, *, K, modes, grid, scal, ols, shapes,
                 interpret=False):
     """Advance K coupled iterations on the extended buffers; returns the
-    four central local blocks."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+    four central local blocks.  Compiled mode runs the engine's generic
+    VMEM-resident banded kernel with this family's config (4 updated
+    fields + const Rho, Vx's x-staggered high margin 2, velocities
+    frozen on open dims); interpret mode runs the pure-XLA window
+    realization."""
     E = 2 * K
-    ext_shapes = [tuple(x.shape) for x in exts] + [tuple(Rho_ext.shape)]
 
-    def central(F, f):
-        for d in range(3):
-            if modes[d] in ("ext", "oext"):
-                F = lax.slice_in_dim(F, E, E + shapes[f][d], axis=d)
-        return F
+    def window():
+        return _window_iters_xla(*exts, Rho_ext, K=K, E=E, modes=modes,
+                                 grid=grid, scal=scal, ols=ols,
+                                 shapes=shapes)
 
-    if interpret:
-        out = _window_iters_xla(*exts, Rho_ext, K=K, E=E, modes=modes,
-                                grid=grid, scal=scal, ols=ols,
-                                shapes=shapes)
-        return tuple(central(F, f) for f, F in enumerate(out))
-
-    S0e = ext_shapes[0][0]
-    bx = _BX
-    nb = S0e // bx
-    cfg = dict(modes=tuple(modes), ols=tuple(ols[:4]),
-               ext_shapes=tuple(ext_shapes), E=E,
-               shapes=tuple(shapes[:4]))
-
-    # Tile-pad the staggered trailing extents so every leading-dim VMEM
-    # slice in the kernel is tile-aligned; the pad columns carry garbage
-    # the central slices never see.
-    def padded(F, f):
-        s = F.shape
-        py = _pad8(s[1]) - s[1]
-        pz = _pad128(s[2]) - s[2]
-        if py or pz:
-            F = jnp.pad(F, [(0, 0), (0, py), (0, pz)])
-        return F
-
-    fields5 = [padded(F, f) for f, F in enumerate(list(exts) + [Rho_ext])]
-    pads = [(_pad8(s[1]) - s[1], _pad128(s[2]) - s[2])
-            for s in ext_shapes[:4]]
-
-    # Open-dim freeze planes (chunk-entry boundary planes of the three
-    # velocity fields) + per-device SMEM edge flags, as in the diffusion
-    # chunk kernel ("frozen" dims statically flag both sides, so 1-device
-    # frozen grids run under plain jax.jit).
-    fr_planes = []
-    flag_ops = []
-    any_open = any(m in ("oext", "frozen") for m in modes)
-    if any_open:
-        for d in range(3):
-            if modes[d] not in ("oext", "frozen"):
-                continue
-            lo = E if modes[d] == "oext" else 0
-            for f in (1, 2, 3):
-                hi = lo + shapes[f][d] - 1
-                for idx in (lo, hi):
-                    p = jnp.squeeze(
-                        lax.slice_in_dim(exts[f], idx, idx + 1, axis=d), d)
-                    ps = p.shape
-                    py = _pad8(ps[0]) - ps[0]
-                    pz = _pad128(ps[1]) - ps[1]
-                    if py or pz:
-                        p = jnp.pad(p, [(0, py), (0, pz)])
-                    fr_planes.append(p)
-        from .diffusion_trapezoid import _edge_flags
-
-        flag_ops = [_edge_flags(modes, grid)]
-    nfr = len(fr_planes)
-
-    kern = partial(_kernel, K=K, bx=bx, scal=scal, cfg=cfg, nfr=nfr,
-                   pads=pads)
-
-    operands = [*fields5, *flag_ops, *fr_planes]
-    vmas = [getattr(getattr(x, "aval", None), "vma", None)
-            for x in operands]
-    vma = frozenset().union(*[v for v in vmas if v])
-
-    def shp(s):
-        return (jax.ShapeDtypeStruct(s, exts[0].dtype, vma=vma) if vma
-                else jax.ShapeDtypeStruct(s, exts[0].dtype))
-
-    # Scratch order MUST mirror the kernel's unpack: field/lag VMEM,
-    # freeze-plane VMEM, load semaphores, out semaphores, then the
-    # freeze-plane semaphore LAST (present only when a dim is open).
-    fr_scratch = [pltpu.VMEM(p.shape, p.dtype) for p in fr_planes]
-    out = pl.pallas_call(
-        kern,
-        grid=(K, nb),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(flag_ops)
-        + [pl.BlockSpec(memory_space=pl.ANY)] * nfr,
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
-        out_shape=[shp(F.shape) for F in fields5[:4]],
-        # The entry buffers are dead after the (k==0, i==0) load; rows the
-        # band grid never writes (Vx's top face) keep their entry values.
-        input_output_aliases={0: 0, 1: 1, 2: 2, 3: 3},
-        scratch_shapes=[pltpu.VMEM(F.shape, F.dtype) for F in fields5]
-        + [pltpu.VMEM((2, F.shape[1], F.shape[2]), F.dtype)
-           for F in fields5[:4]]
-        + fr_scratch
-        + [pltpu.SemaphoreType.DMA((5,)), pltpu.SemaphoreType.DMA((4,))]
-        + ([pltpu.SemaphoreType.DMA((nfr,))] if nfr else []),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=128 * 1024 * 1024,
-            dimension_semantics=("arbitrary", "arbitrary")),
-    )(*operands)
-    out = [F[:, :ext_shapes[f][1], :ext_shapes[f][2]]
-           for f, F in enumerate(out)]
-    return tuple(central(F, f) for f, F in enumerate(out))
+    return resident_chunk_call(
+        list(exts), [Rho_ext], K=K, bx=_BX, modes=modes, grid=grid,
+        ols=ols, shapes=shapes, E=E,
+        band_update=partial(_band_update, scal=scal),
+        extras=(1, 2, 1, 1, 1), freeze_fields=(1, 2, 3),
+        window_fallback=window, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -750,8 +328,6 @@ def fused_stokes_trapezoid_iters(P, Vx, Vy, Vz, Rho, *, n_inner: int,
     invariant of the model paths.  Call inside SPMD code (`igg.sharded`
     / shard_map); fully-frozen 1-device grids also run under plain
     `jax.jit`."""
-    from jax import lax
-
     from .. import shared
 
     grid = shared.global_grid()
@@ -760,17 +336,17 @@ def fused_stokes_trapezoid_iters(P, Vx, Vy, Vz, Rho, *, n_inner: int,
     shapes = _field_shapes(P.shape)
     ols = _ols(grid, shapes)
     scal = dict(dx=dx, dy=dy, dz=dz, mu=mu, dtP=dtP, dtV=dtV)
-    chunks = n_inner // K
 
     # Rho never changes: its extension (one grouped ppermute set) is
     # hoisted out of the chunk loop entirely.
-    Rho_ext = _extend_fields([Rho], [ols[4]], E, grid, modes)[0]
+    Rho_ext = extend_fields([Rho], [ols[4]], E, grid, modes)[0]
 
-    def one(_, S):
-        exts = _extend_fields(list(S), ols[:4], E, grid, modes)
+    def one(P, Vx, Vy, Vz):
+        exts = extend_fields([P, Vx, Vy, Vz], ols[:4], E, grid, modes)
         return _chunk_call(exts, Rho_ext, K=K, modes=modes, grid=grid,
                            scal=scal, ols=ols, shapes=shapes,
                            interpret=interpret)
 
-    S = lax.fori_loop(0, chunks, one, (P, Vx, Vy, Vz))
-    return (*S, chunks * K)
+    *S, done = run_chunks((P, Vx, Vy, Vz), n_inner=n_inner, K=K,
+                          one_chunk=one)
+    return (*S, done)

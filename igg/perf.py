@@ -396,7 +396,12 @@ def query(family: Optional[str] = None, *, tier: Optional[str] = None,
         if device_kind is not None and e["device_kind"] != device_kind:
             continue
         out.append(e)
-    out.sort(key=lambda e: e["best_ms"])
+    # Deterministic order with tie-breaking (round 16 — the autotuner's
+    # prior must be stable when two tiers measure equal-best): best_ms
+    # first, ties broken toward the better-evidenced entry (higher
+    # sample count), then the freshest (updated_wall), then tier name.
+    out.sort(key=lambda e: (e["best_ms"], -e["count"],
+                            -e.get("updated_wall", 0.0), e["tier"]))
     return out
 
 
@@ -429,9 +434,11 @@ def invalidate(family: str, tier: Optional[str] = None) -> int:
     entries are also dropped from the per-file persisted baselines, so a
     later :func:`save` merges the replacement samples into the on-disk
     ledger as new deltas (the file keeps the old aggregates as history —
-    merge-on-write is append-only by design).  Emits one
-    ``perf_invalidated`` bus record; returns the number of entries
-    dropped."""
+    merge-on-write is append-only by design).  The family's TUNING-CACHE
+    entries are evicted too (:func:`igg.autotune.invalidate` — a ledger
+    a drift verdict just emptied must not keep serving the winner it
+    once picked; round 16).  Emits one ``perf_invalidated`` bus record;
+    returns the number of ledger entries dropped."""
     with _lock:
         keys = [k for k in _LEDGER
                 if k[0] == family and (tier is None or k[1] == tier)]
@@ -444,8 +451,14 @@ def invalidate(family: str, tier: Optional[str] = None) -> int:
         for dk in [d for d in _DRIFT_EMITTED if d[0] == family
                    and (tier is None or d[1] == tier)]:
             _DRIFT_EMITTED.discard(dk)
+    try:
+        from . import autotune as _autotune
+
+        tune_evicted = _autotune.invalidate(family, tier=tier)
+    except Exception:   # pragma: no cover - advisory path
+        tune_evicted = 0
     _telemetry.emit("perf_invalidated", family=family, tier=tier,
-                    entries=len(keys))
+                    entries=len(keys), tune_evicted=tune_evicted)
     return len(keys)
 
 
@@ -525,10 +538,16 @@ def _default_family_step(family: str, dtype):
         # model run()'s own wrapper shape).
         return (lambda P, Vx, Vy, Vz, Rho:
                 it(P, Vx, Vy, Vz, Rho) + (Rho,)), tuple(fields)
+    if family == "wave2d":
+        from .models import wave2d as m
+
+        fields = m.init_fields(m.Params(), dtype=dtype)
+        step = m.make_step(m.Params(), donate=False)
+        return (lambda P, Vx, Vy: step(P, Vx, Vy)), tuple(fields)
     raise GridError(
         f"igg.perf.calibrate: unknown family {family!r} (known: "
-        f"diffusion3d, hm3d, stokes3d; pass a step callable + args for "
-        f"anything else).")
+        f"diffusion3d, hm3d, stokes3d, wave2d; pass a step callable + "
+        f"args for anything else).")
 
 
 def calibrate(model, args=None, *, family: Optional[str] = None,
@@ -970,10 +989,18 @@ def _main(argv: Sequence[str]) -> int:
 
     usage = (
         "usage: python -m igg.perf show [<ledger.json>] [--family F]\n"
+        "           [--tier T]\n"
+        "       python -m igg.perf tune [<cache.json>] [--family F]\n"
+        "           [--ledger <ledger.json>]\n"
         "       python -m igg.perf merge <out.json> <ledger.json> [...]\n"
         "       python -m igg.perf compare <baseline> <new> [--tol X]\n"
         "           [--allow-missing] [--gate-pass-values]\n"
-        "  show     print a ledger (default: $IGG_PERF_LEDGER) as a table\n"
+        "  show     print a ledger (default: $IGG_PERF_LEDGER) as a table,\n"
+        "           optionally filtered to one family and/or tier (the\n"
+        "           per-signature view the tuning work reads)\n"
+        "  tune     print the autotuner's tuning cache (default:\n"
+        "           $IGG_TUNE_CACHE) next to the ledger prior each winner\n"
+        "           came from\n"
         "  merge    merge ledger files into one (aggregates combine)\n"
         "  compare  regression-gate benchmark JSONL rows/dirs; exit 1 on\n"
         "           regressions (or missing golden rows)")
@@ -982,13 +1009,19 @@ def _main(argv: Sequence[str]) -> int:
         print(usage, file=sys.stderr)
         return 2
     cmd, rest = argv[0], argv[1:]
+
+    def take_flag(name):
+        if name in rest:
+            i = rest.index(name)
+            val = rest[i + 1]
+            del rest[i:i + 2]
+            return val
+        return None
+
     try:
         if cmd == "show":
-            fam = None
-            if "--family" in rest:
-                i = rest.index("--family")
-                fam = rest[i + 1]
-                del rest[i:i + 2]
+            fam = take_flag("--family")
+            tier_f = take_flag("--tier")
             path = rest[0] if rest else ledger_path()
             if path is None:
                 print("igg.perf show: no ledger given and IGG_PERF_LEDGER "
@@ -997,9 +1030,51 @@ def _main(argv: Sequence[str]) -> int:
             entries = _read_ledger_file(path)
             if fam is not None:
                 entries = [e for e in entries if e["family"] == fam]
+            if tier_f is not None:
+                entries = [e for e in entries if e["tier"] == tier_f]
             print(f"# {path} ({len(entries)} entr"
                   f"{'y' if len(entries) == 1 else 'ies'})")
             sys.stdout.write(_format_entries(entries))
+            return 0
+        if cmd == "tune":
+            from . import autotune
+
+            fam = take_flag("--family")
+            ledger_arg = take_flag("--ledger")
+            path = rest[0] if rest else autotune.cache_path()
+            if path is None:
+                print("igg.perf tune: no cache given and IGG_TUNE_CACHE "
+                      "is unset.", file=sys.stderr)
+                return 2
+            entries = autotune._read_cache_file(path)
+            if fam is not None:
+                entries = [e for e in entries if e["family"] == fam]
+            lpath = ledger_arg or ledger_path()
+            led = []
+            if lpath is not None and pathlib.Path(lpath).exists():
+                led = _read_ledger_file(lpath)
+            print(f"# {path} ({len(entries)} winner"
+                  f"{'' if len(entries) == 1 else 's'})"
+                  + (f" vs prior {lpath}" if led else " (no ledger prior)"))
+            header = (f"{'family':<12} {'local_shape':<14} {'tier':<22} "
+                      f"{'K':>3} {'bx':>3} {'vmem':>5} {'ms':>9}  "
+                      f"prior (ledger best)")
+            print(header)
+            for e in sorted(entries, key=lambda e: (e["family"],
+                                                    str(e["local_shape"]))):
+                shape = "x".join(map(str, e.get("local_shape") or [])) or "-"
+                prior = [l for l in led
+                         if l["family"] == e["family"]
+                         and tuple(l.get("local_shape") or ())
+                         == tuple(e.get("local_shape") or ())]
+                prior.sort(key=lambda l: l["best_ms"])
+                ptxt = (f"{prior[0]['tier']} @ {prior[0]['best_ms']:.4f} ms"
+                        if prior else "-")
+                print(f"{e['family']:<12} {shape:<14} "
+                      f"{e.get('tier') or '-':<22} "
+                      f"{e.get('K') or '-':>3} {e.get('bx') or '-':>3} "
+                      f"{str(e.get('vmem_mb') or '-'):>5} "
+                      f"{(e.get('ms') or 0):>9.4f}  {ptxt}")
             return 0
         if cmd == "merge":
             if len(rest) < 2:

@@ -1,0 +1,306 @@
+"""igg.autotune — the ledger-driven (tier, K, bx, vmem) search, the
+on-disk tuning cache, the factory `tune=` application, and the
+heal-loop staleness interplay (perf.invalidate evicting cached
+winners), on the 8-device interpret mesh."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import igg
+from igg import autotune, perf
+from igg import telemetry as tel
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Isolated ledger + tuning cache per test (both are process-global
+    by design); the cache file lives under tmp_path."""
+    monkeypatch.setenv("IGG_TUNE_CACHE", str(tmp_path / "tune.json"))
+    perf.reset()
+    autotune.reset()
+    tel.reset_metrics()
+    tel._ring().clear()
+    yield
+    perf.reset()
+    autotune.reset()
+    tel.reset_metrics()
+
+
+def _diffusion_grid():
+    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    from igg.models import diffusion3d as d3
+
+    return d3, d3.Params(lx=8.0, ly=8.0, lz=60.0)
+
+
+# ---------------------------------------------------------------------------
+# The knob contract
+# ---------------------------------------------------------------------------
+
+def test_resolve_contract(monkeypatch):
+    assert autotune.resolve(False) is False
+    assert autotune.resolve(True) is True
+    assert autotune.resolve("auto") == "auto"
+    assert autotune.resolve(None) == "auto"        # env unset
+    monkeypatch.setenv("IGG_TUNE", "0")
+    assert autotune.resolve(None) is False
+    monkeypatch.setenv("IGG_TUNE", "1")
+    assert autotune.resolve(None) is True
+    with pytest.raises(igg.GridError):
+        autotune.resolve("sometimes")
+    monkeypatch.setenv("IGG_TUNE", "banana")
+    with pytest.raises(igg.GridError):
+        autotune.resolve(None)
+
+
+def test_applied_off_and_no_grid():
+    # tune=False and no-grid are both clean no-ops.
+    assert autotune.applied("diffusion3d", False) is None
+    assert autotune.applied("diffusion3d", "auto") is None
+
+
+# ---------------------------------------------------------------------------
+# The search: empty-ledger seed -> winner <= the hand-picked config
+# ---------------------------------------------------------------------------
+
+def test_search_converges_and_beats_hand_picked():
+    """Seeded with an EMPTY ledger, the search over (tier, K/bx)
+    candidates for f32 diffusion on the smoke mesh must converge to a
+    winner whose measured step time is <= the hand-picked bx=8
+    candidate's, enrich the ledger with autotune-sourced samples, and
+    persist the winner."""
+    d3, params = _diffusion_grid()
+    assert perf.best("diffusion3d") is None      # empty-ledger seed
+    w = autotune.search("diffusion3d", n_inner=9, params=params,
+                        interpret=True, nt=1)
+    assert w is not None and w["tier"].startswith("diffusion3d.")
+    assert autotune.search_dispatches() > 0
+    # Winner <= the hand-picked K=8 config, from the search's own
+    # samples on the bus.
+    samples = [r.payload for r in tel.flight_recorder()
+               if r.kind == "autotune_sample"]
+    hand = [s for s in samples if "bx=8" in s["candidate"]]
+    assert hand, samples
+    assert w["ms"] <= min(s["ms_per_step"] for s in hand) * (1 + 1e-9)
+    # The ledger is now the enriched prior.
+    entries = perf.query("diffusion3d")
+    assert entries and all("autotune" in e["sources"] for e in entries)
+    # Winner persisted to the on-disk cache, versioned format.
+    doc = json.loads(pathlib.Path(autotune.cache_path()).read_text())
+    assert doc["format"] == autotune.TUNE_FORMAT
+    assert any(e["family"] == "diffusion3d"
+               for e in doc["entries"].values())
+
+
+def test_prior_orders_candidates_first():
+    """A ledger prior puts its tier's candidates first in the walk (the
+    cutoff threshold is then set by the likely winner)."""
+    d3, params = _diffusion_grid()
+    ctx = autotune._context("diffusion3d")
+    perf.record("diffusion3d", "diffusion3d.xla", 0.5, source="calibrate",
+                local_shape=ctx["local_shape"], dtype="float32",
+                dims=ctx["dims"], backend=ctx["backend"],
+                device_kind=ctx["device_kind"])
+    w = autotune.search("diffusion3d", n_inner=9, params=params,
+                        interpret=True, nt=1)
+    samples = [r.payload for r in tel.flight_recorder()
+               if r.kind == "autotune_sample"]
+    assert samples[0]["candidate"].startswith("[diffusion3d.xla")
+    assert w is not None
+
+
+# ---------------------------------------------------------------------------
+# Cache round trip: the second process performs zero search dispatches
+# ---------------------------------------------------------------------------
+
+def test_cache_round_trip_zero_search():
+    d3, params = _diffusion_grid()
+    w = autotune.search("diffusion3d", n_inner=9, params=params,
+                        interpret=True, nt=1)
+    # "Second process": fresh in-memory state, same cache file.
+    autotune.reset()
+    assert autotune.search_dispatches() == 0
+    w2 = autotune.applied("diffusion3d", "auto")
+    assert w2 is not None and w2["tier"] == w["tier"]
+    assert w2.get("bx") == w.get("bx")
+    # tune=True with a cache HIT must not search either.
+    w3 = autotune.applied("diffusion3d", True, n_inner=9, params=params,
+                         interpret=True)
+    assert w3 is not None and autotune.search_dispatches() == 0
+    # The factory consumes the winner without dispatching a search.
+    step = d3.make_multi_step(9, params, donate=False, tune="auto",
+                              pallas_interpret=True)
+    assert autotune.search_dispatches() == 0
+    T, Cp = d3.init_fields(params, dtype=np.float32)
+    step(T, Cp)   # serves normally with the tuned config applied
+
+
+def test_explicit_args_beat_cached_winner():
+    """A caller-pinned bx must never be overridden by the cache."""
+    d3, params = _diffusion_grid()
+    autotune.record_winner("diffusion3d",
+                           {"tier": "diffusion3d.mosaic", "K": 4, "bx": 4,
+                            "vmem_mb": None, "ms": 0.1})
+    captured = {}
+    import igg.ops as ops
+
+    real = ops.fused_diffusion_steps
+
+    def spy(T, Cp, **kw):
+        captured["bx"] = kw.get("bx")
+        return real(T, Cp, **kw)
+
+    step = d3.make_multi_step(9, params, donate=False, tune="auto",
+                              pallas_interpret=True, bx=8,
+                              use_pallas=True)
+    import igg.models.diffusion3d  # noqa: F401  (factory built above)
+    try:
+        ops.fused_diffusion_steps = spy
+        T, Cp = d3.init_fields(params, dtype=np.float32)
+        step(T, Cp)
+    finally:
+        ops.fused_diffusion_steps = real
+    assert captured.get("bx") == 8
+
+
+# ---------------------------------------------------------------------------
+# Staleness: drift -> perf.invalidate -> tuning-cache eviction
+# ---------------------------------------------------------------------------
+
+def test_perf_invalidate_evicts_tuning_cache():
+    """The heal-loop interplay: a ``cost_model_drift``-driven
+    :func:`igg.perf.invalidate` must evict the family's cached winner —
+    memory AND disk — so a drifted machine re-tunes instead of serving
+    a stale winner."""
+    autotune.record_winner("myfam", {"tier": "myfam.mosaic", "K": 8,
+                                     "bx": 8, "vmem_mb": None, "ms": 1.0},
+                           local_shape=(32, 32, 32))
+    assert autotune.get("myfam", local_shape=(32, 32, 32)) is not None
+    # A stale prediction + measured samples fire cost_model_drift...
+    perf.predict("myfam", 0.010)                      # 10 ms predicted
+    perf.record("myfam", "myfam.mosaic", 2.0, local_shape=(32, 32, 32),
+                dtype="float32")
+    drifts = [r for r in tel.flight_recorder()
+              if r.kind == "cost_model_drift"]
+    assert drifts and drifts[0].payload["family"] == "myfam"
+    # ...whose heal action is recalibrate -> perf.invalidate -> eviction
+    # (myfam is not a model family, so recalibrate re-anchors to the
+    # freshest sample instead of dispatching a calibration).
+    igg.heal.recalibrate("myfam")
+    assert autotune.get("myfam", local_shape=(32, 32, 32)) is None
+    evs = [r for r in tel.flight_recorder() if r.kind == "tune_invalidated"]
+    assert evs and evs[0].payload["family"] == "myfam"
+    # Durable: the on-disk cache no longer carries the entry either.
+    path = autotune.cache_path()
+    if path.exists():
+        doc = json.loads(path.read_text())
+        assert not any(e.get("family") == "myfam"
+                       for e in doc["entries"].values())
+    # And a recalibrated event closed the loop.
+    assert any(r.kind == "recalibrated" for r in tel.flight_recorder())
+
+
+def test_invalidate_tier_scoped():
+    autotune.record_winner("famA", {"tier": "famA.mosaic", "K": None,
+                                    "bx": 8, "vmem_mb": None, "ms": 1.0},
+                           local_shape=(8, 8, 8))
+    assert autotune.invalidate("famA", tier="famA.trapezoid") == 0
+    assert autotune.get("famA", local_shape=(8, 8, 8)) is not None
+    assert autotune.invalidate("famA", tier="famA.mosaic") == 1
+    assert autotune.get("famA", local_shape=(8, 8, 8)) is None
+
+
+# ---------------------------------------------------------------------------
+# Persistence: merge-on-write, newest wins, corrupt-file tolerance
+# ---------------------------------------------------------------------------
+
+def test_save_merges_and_newest_wins(tmp_path):
+    p = tmp_path / "tune.json"
+    autotune.record_winner("f1", {"tier": "f1.xla", "K": None, "bx": None,
+                                  "vmem_mb": None, "ms": 2.0},
+                           local_shape=(8, 8, 8))
+    autotune.save(p)
+    # A "concurrent" process writes a different family...
+    autotune.reset()
+    autotune.record_winner("f2", {"tier": "f2.xla", "K": None, "bx": None,
+                                  "vmem_mb": None, "ms": 3.0},
+                           local_shape=(8, 8, 8))
+    autotune.save(p)
+    doc = json.loads(p.read_text())
+    fams = {e["family"] for e in doc["entries"].values()}
+    assert fams == {"f1", "f2"}       # merge-on-write lost nothing
+    # ...and a NEWER winner for f1 replaces the old one.
+    autotune.reset()
+    autotune.record_winner("f1", {"tier": "f1.mosaic", "K": 8, "bx": 8,
+                                  "vmem_mb": None, "ms": 1.0},
+                           local_shape=(8, 8, 8))
+    autotune.save(p)
+    doc = json.loads(p.read_text())
+    f1 = [e for e in doc["entries"].values() if e["family"] == "f1"]
+    assert len(f1) == 1 and f1[0]["tier"] == "f1.mosaic"
+
+
+def test_corrupt_cache_never_fatal(tmp_path, monkeypatch):
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    monkeypatch.setenv("IGG_TUNE_CACHE", str(p))
+    autotune.reset()
+    assert autotune.get("anything", local_shape=(4, 4, 4)) is None
+    with pytest.raises(igg.GridError):
+        autotune.load(p)
+
+
+def test_cached_K_falls_back_to_fit_on_smaller_n_inner():
+    """The cache key has no n_inner axis: a tuned K=8 winner applied to
+    a factory whose n_inner only fits K=4 must FALL BACK to the
+    auto-fitted depth and still serve the chunk tier (a caller-pinned K
+    keeps hard-refusing — `_dispatch.resolve_chunk_K`)."""
+    _diffusion_grid()   # same mesh works for hm3d's 16x16x128 blocks
+    from igg.models import hm3d
+
+    autotune.record_winner("hm3d", {"tier": "hm3d.trapezoid", "K": 8,
+                                    "bx": None, "vmem_mb": None,
+                                    "ms": 1.0})
+    p = hm3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    Pe, phi = hm3d.init_fields(p, dtype=np.float32)
+    # n_inner=5: only K=4 fits (warm-up + one chunk).  The cached K=8
+    # must not disable the tier.
+    step = hm3d.make_step(p, donate=False, n_inner=5, use_pallas=True,
+                          pallas_interpret=True, trapezoid="auto",
+                          tune="auto")
+    step(Pe, phi)
+    assert igg.degrade.active().get("hm3d") == "hm3d.trapezoid"
+    # A CALLER-pinned inapplicable K still hard-refuses.
+    pinned = hm3d.make_step(p, donate=False, n_inner=5, use_pallas=True,
+                            pallas_interpret=True, trapezoid=True, K=8,
+                            tune=False)
+    with pytest.raises(igg.GridError, match="chunk tier"):
+        pinned(Pe, phi)
+
+
+def test_applied_normalizes_vmem_cap():
+    """The process-global VMEM cap follows the factory being built: a
+    capped winner installs it, a miss or tune=False clears it."""
+    from igg.ops import _vmem
+
+    _diffusion_grid()
+    try:
+        autotune.record_winner("diffusion3d",
+                               {"tier": "diffusion3d.mosaic", "K": 8,
+                                "bx": 8, "vmem_mb": 64, "ms": 1.0})
+        w = autotune.applied("diffusion3d", "auto")
+        assert w is not None and _vmem.vmem_cap() == 64 * 1024 * 1024
+        # A MISS for another family clears the leaked cap.
+        assert autotune.applied("stokes3d", "auto") is None
+        assert _vmem.vmem_cap() == _vmem.VMEM_CAP
+        # Reinstall, then an explicitly-untuned factory clears it too.
+        autotune.applied("diffusion3d", "auto")
+        assert _vmem.vmem_cap() == 64 * 1024 * 1024
+        assert autotune.applied("diffusion3d", False) is None
+        assert _vmem.vmem_cap() == _vmem.VMEM_CAP
+    finally:
+        _vmem.set_cap_override(None)

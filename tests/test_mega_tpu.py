@@ -663,3 +663,106 @@ def test_stokes_trapezoid_matches_per_iteration(periods):
         rel = float(jnp.max(jnp.abs(a - b))) / scale
         assert rel < 1e-4, (name, rel, periods)
     igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0)],
+                         ids=["selfwrap", "open_frozen"])
+def test_hm3d_trapezoid_matches_per_step_kernel(periods):
+    """The K-step HM3D chunk kernel (the chunk engine's generic
+    VMEM-resident banded kernel, `igg.ops.chunk_engine._resident_kernel`,
+    instantiated by `igg.ops.hm3d_trapezoid`) against the per-step fused
+    kernel on the 1-device 128^3 grid — periodic self-wrap and all-open
+    (both fields' boundary planes frozen).  The window-vs-composition
+    equivalence is pinned on CPU meshes by tests/test_chunk_engine.py;
+    this pins the compiled banded realization on hardware."""
+    import jax.numpy as jnp
+
+    from igg.models import hm3d
+    from igg.ops.hm3d_trapezoid import fit_hm3d_K
+
+    igg.init_global_grid(128, 128, 128, dimx=1, dimy=1, dimz=1,
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    grid = igg.get_global_grid()
+    params = hm3d.Params()
+    Pe, phi = hm3d.init_fields(params, dtype=np.float32)
+
+    n_inner = 9          # warm-up + one K=8 chunk
+    assert fit_hm3d_K(grid, (128, 128, 128), n_inner - 1, np.float32) == 8
+
+    ref = hm3d.make_step(params, donate=False, n_inner=n_inner,
+                         trapezoid=False)
+    chk = hm3d.make_step(params, donate=False, n_inner=n_inner,
+                         trapezoid=True)
+    r = ref(Pe, phi)
+    o = chk(Pe, phi)
+    assert igg.degrade.active().get("hm3d") == "hm3d.trapezoid"
+    for name, a, b in zip(("Pe", "phi"), r, o):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-30
+        rel = float(jnp.max(jnp.abs(a - b))) / scale
+        assert rel < 1e-4, (name, rel, periods)
+    igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+@pytest.mark.parametrize("periods", [(1, 1), (0, 0)],
+                         ids=["periodic", "open"])
+def test_wave2d_mosaic_compiled_matches_xla(periods):
+    """The fused wave2d per-step kernel, COMPILED (Mosaic whole-block
+    program), against the XLA composition on a 1-device grid."""
+    import jax.numpy as jnp
+
+    from igg.models import wave2d
+
+    igg.init_global_grid(512, 512, 1, periodx=periods[0],
+                         periody=periods[1], quiet=True)
+    params = wave2d.Params()
+    fields = wave2d.init_fields(params, dtype=np.float32)
+    ref = wave2d.make_step(params, donate=False, n_inner=5,
+                           use_pallas=False)
+    pal = wave2d.make_step(params, donate=False, n_inner=5,
+                           use_pallas=True, chunk=False)
+    r = ref(*fields)
+    o = pal(*fields)
+    assert igg.degrade.active().get("wave2d") == "wave2d.mosaic"
+    for name, a, b in zip(("P", "Vx", "Vy"), r, o):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-30
+        rel = float(jnp.max(jnp.abs(a - b))) / scale
+        assert rel < 1e-5, (name, rel, periods)
+    igg.finalize_global_grid()
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_wave2d_chunk_compiled_matches_per_step():
+    """The K-step wave2d chunk kernel (compiled whole-window resident
+    program, `igg.ops.wave2d_pallas._chunk_kernel`) against the per-step
+    fused kernel on a 1-device periodic grid."""
+    import jax.numpy as jnp
+
+    from igg.models import wave2d
+    from igg.ops.wave2d_pallas import fit_wave2d_K
+
+    igg.init_global_grid(512, 512, 1, periodx=1, periody=1, quiet=True)
+    grid = igg.get_global_grid()
+    params = wave2d.Params()
+    fields = wave2d.init_fields(params, dtype=np.float32)
+    pre = wave2d.make_step(params, donate=False, n_inner=3,
+                           use_pallas=True, chunk=False)
+    fields = pre(*fields)
+
+    n_inner = 9          # warm-up + one K=8 chunk
+    assert fit_wave2d_K(grid, (512, 512), n_inner - 1, np.float32) == 8
+
+    ref = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                           use_pallas=True, chunk=False)
+    chk = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                           use_pallas=True, chunk=True)
+    r = ref(*fields)
+    o = chk(*fields)
+    assert igg.degrade.active().get("wave2d") == "wave2d.chunk"
+    for name, a, b in zip(("P", "Vx", "Vy"), r, o):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-30
+        rel = float(jnp.max(jnp.abs(a - b))) / scale
+        assert rel < 1e-4, (name, rel)
+    igg.finalize_global_grid()

@@ -484,3 +484,73 @@ def test_perf_env_knobs_registered():
     for name in ("IGG_PERF", "IGG_PERF_LEDGER", "IGG_PERF_SAVE_EVERY",
                  "IGG_PERF_DRIFT_TOL"):
         assert name in _env._KNOWN, name
+
+
+# ---------------------------------------------------------------------------
+# Round 16: best() tie-breaking + tuning-cache staleness (autotuner prior)
+# ---------------------------------------------------------------------------
+
+def test_best_tie_breaking_deterministic():
+    """Equal-best samples from different sources must order
+    deterministically: higher sample count first, then the freshest
+    `updated_wall`, then tier name — so the autotuner's prior is stable
+    run to run."""
+    import time as _time
+
+    # Same best_ms from two different sources; the second tier gathers
+    # more evidence (count 3 vs 1).
+    perf.record("f", "f.zeta", 1.0, source="watchdog", **CTX)
+    for _ in range(3):
+        perf.record("f", "f.alpha", 1.0, source="calibrate", **CTX)
+    q = perf.query("f")
+    assert [e["tier"] for e in q] == ["f.alpha", "f.zeta"]
+    assert perf.best("f")["tier"] == "f.alpha"
+    # Equal best AND equal count: the fresher entry wins.
+    perf.record("g", "g.old", 2.0, source="bench", **CTX)
+    _time.sleep(0.01)
+    perf.record("g", "g.new", 2.0, source="autotune", **CTX)
+    assert perf.best("g")["tier"] == "g.new"
+    # Fully equal aggregates (count, freshness forced identical): the
+    # tier NAME is the final deterministic key.
+    with perf._lock:
+        for k in list(perf._LEDGER):
+            if k[0] == "g":
+                perf._LEDGER[k]["updated_wall"] = 123.0
+                perf._LEDGER[k]["count"] = 1
+    assert perf.best("g")["tier"] == "g.new"   # "g.new" < "g.old"
+
+
+def test_invalidate_evicts_tuning_cache_entries(tmp_path, monkeypatch):
+    """The heal-loop interplay on the 8-device mesh: `invalidate()`
+    dropping a family's ledger entries must also evict its tuning-cache
+    winners (memory and disk), and report the eviction count on the
+    `perf_invalidated` bus record."""
+    from igg import autotune
+
+    monkeypatch.setenv("IGG_TUNE_CACHE", str(tmp_path / "tune.json"))
+    autotune.reset()
+    _grid()
+    try:
+        ctx = perf.sample_context()
+        perf.record("diffusion3d", "diffusion3d.mosaic", 1.0,
+                    source="autotune", local_shape=(16, 16, 128),
+                    dtype="float32", dims=ctx.get("dims"),
+                    backend=ctx.get("backend"),
+                    device_kind=ctx.get("device_kind"))
+        autotune.record_winner(
+            "diffusion3d", {"tier": "diffusion3d.mosaic", "K": 8, "bx": 8,
+                            "vmem_mb": None, "ms": 1.0},
+            local_shape=(16, 16, 128))
+        assert autotune.get("diffusion3d",
+                            local_shape=(16, 16, 128)) is not None
+        n = perf.invalidate("diffusion3d")
+        assert n == 1
+        assert perf.best("diffusion3d") is None
+        assert autotune.get("diffusion3d",
+                            local_shape=(16, 16, 128)) is None
+        inv = [r for r in tel.flight_recorder()
+               if r.kind == "perf_invalidated"]
+        assert inv and inv[-1].payload["tune_evicted"] == 1
+    finally:
+        autotune.reset()
+        igg.finalize_global_grid()
