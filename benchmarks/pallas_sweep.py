@@ -310,7 +310,11 @@ def main():
     # by tests/test_mega_tpu.py on hardware.
     from igg.models import hm3d as _hm
 
-    igg.init_global_grid(16, 16, 128, quiet=True)   # all dims open
+    # Pin the (8,1,1) ring (the tests' K=4 config): automatic dims pick
+    # (2,2,2) here, whose y-extension E=4 trips the sublane-tile gate
+    # and crashed the required-tier dispatch below.
+    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
+                         quiet=True)   # all dims open
     hp = _hm.Params(lx=4.0, ly=4.0, lz=4.0)
     hPe, hphi = _hm.init_fields(hp, dtype=np.float32)
     n5 = 5   # warm-up + one K=4 chunk
@@ -359,6 +363,64 @@ def main():
             "local": 16, "value": round(sec / n5 * 1e3, 4), "unit": "ms",
             "platform": platform, "rel_vs_composition": wrel,
             "pass": bool(wrel < 1e-4),
+        })
+    igg.finalize_global_grid()
+
+    # Round 17: the SPEC-GENERATED rungs (igg.stencil), emitted on EVERY
+    # platform as CONTRACT rows and golden-gated like the round-16 ones.
+    # The spec-wave2d chunk row's oracle is the HAND-WRITTEN module's
+    # composition (the frontend's bit-exactness contract); the
+    # shallow-water rows — a family with ZERO hand-written kernel code —
+    # gate against their own generated XLA truth.
+    from igg import stencil as _st
+    from igg.models import shallow_water as _sw
+
+    igg.init_global_grid(16, 16, 1, periodx=1, periody=1, quiet=True)
+    wp = _w2.Params()
+    wP, wVx, wVy = _w2.init_fields(wp, dtype=np.float32)
+    wref = _w2.make_step(wp, donate=False, n_inner=n5,
+                         use_pallas=False)(wP, wVx, wVy)
+    sstep = _st.compile(_st.wave2d_spec(), coeffs=_st.wave2d_coeffs(wp),
+                        donate=False, n_inner=n5, use_pallas=True,
+                        pallas_interpret=True, chunk=True, K=4)
+    so = sstep(wP, wVx, wVy)
+    srel = max(
+        float(abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))
+              .max() / (abs(np.asarray(a, np.float64)).max() + 1e-30))
+        for a, b in zip(wref, so))
+    _, sec = time_steps(lambda P, Vx, Vy: sstep(P, Vx, Vy),
+                        (wP, wVx, wVy), n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "stencil_wave2d_chunk_interpret_K4", "local": 16,
+        "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+        "platform": platform, "rel_vs_hand_composition": srel,
+        "pass": bool(srel < 1e-4),
+    })
+
+    sp = _sw.Params()
+    sfields = _sw.init_fields(sp, dtype=np.float32)
+    sref = _sw.make_step(sp, donate=False, n_inner=n5,
+                         use_pallas=False)(*sfields)
+    for tag, kw in (("shallow_water_mosaic_interpret", dict(chunk=False)),
+                    ("shallow_water_chunk_interpret_K4",
+                     dict(chunk=True, K=4))):
+        swstep = _sw.make_step(sp, donate=False, n_inner=n5,
+                               use_pallas=True, pallas_interpret=True,
+                               **kw)
+        swo = swstep(*sfields)
+        swrel = max(
+            float(abs(np.asarray(a, np.float64)
+                      - np.asarray(b, np.float64)).max()
+                  / (abs(np.asarray(a, np.float64)).max() + 1e-30))
+            for a, b in zip(sref, swo))
+        _, sec = time_steps(lambda h, hu, hv: swstep(h, hu, hv),
+                            sfields, n1=2, n2=4)
+        emit({
+            "metric": "pallas_sweep_ms_per_step", "config": tag,
+            "local": 16, "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+            "platform": platform, "rel_vs_composition": swrel,
+            "pass": bool(swrel < 1e-4),
         })
     igg.finalize_global_grid()
 

@@ -50,8 +50,13 @@ fi
 # CONTRACT rows ("pass" = tier output matches the XLA composition) on
 # every platform — golden-gated via the pallas_sweep goldens in the
 # run_all --compare above (GOLDEN_CONTRACT_ONLY keeps exactly these).
+# Round 17 adds the SPEC-GENERATED rungs (igg.stencil): the spec-wave2d
+# chunk tier gated against the HAND-WRITTEN module's composition (the
+# frontend's bit-exactness contract) and the shallow-water family —
+# zero hand-written kernel code — against its own generated XLA truth.
 for cfg in hm3d_trapezoid_open_interpret_K4 wave2d_mosaic_interpret \
-        wave2d_chunk_interpret_K4; do
+        wave2d_chunk_interpret_K4 stencil_wave2d_chunk_interpret_K4 \
+        shallow_water_mosaic_interpret shallow_water_chunk_interpret_K4; do
     if grep "\"config\": \"$cfg\"" \
             benchmarks/results_smoke/pallas_sweep.jsonl \
             | grep -q '"pass": true'; then
@@ -219,6 +224,21 @@ else
     echo "    (benchmarks/results_smoke/resilience_overhead.jsonl)"
     exit 1
 fi
+
+# Round 17: the stencil frontend end to end.  The shallow-water family
+# is pure spec input (zero hand-written kernel code); the example runs
+# the analyzer, serves a clean run from the GENERATED chunk tier, then
+# chaos-miscompiles the generated Mosaic kernel under verify="first_use"
+# inside run_resilient — the numeric check refuses the tier before it
+# serves traffic, quarantines it, and the run completes BIT-EXACT on the
+# generated XLA truth — and asserts the family is registered with
+# igg.perf (analyzer-derived roofline bytes) and igg.autotune
+# (candidate set) like any built-in.
+echo "=== stencil frontend end to end (spec -> tiered dispatch ->"
+echo "    chaos-corrupt generated kernel -> verify refusal -> bit-exact"
+echo "    XLA fallback; 8-device CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/shallow_water.py
 
 echo "=== resilient run loop end-to-end (watchdog -> rollback -> retry,"
 echo "    preemption -> checkpoint -> resume; 8-device CPU mesh) ==="

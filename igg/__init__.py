@@ -120,6 +120,7 @@ from . import heal
 from . import perf
 from . import profiling
 from . import resilience
+from . import stencil
 from . import telemetry
 from . import tools
 from . import vis
@@ -147,5 +148,5 @@ __all__ = [
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
     "telemetry", "Telemetry", "perf", "comm", "heal", "autotune",
-    "time_steps", "__version__",
+    "stencil", "time_steps", "__version__",
 ]

@@ -70,8 +70,9 @@ from . import telemetry as _telemetry
 from .shared import GridError
 
 __all__ = ["TUNE_FORMAT", "applied", "cache_path", "candidates_for", "get",
-           "invalidate", "load", "record_winner", "reset", "resolve",
-           "save", "search", "search_dispatches"]
+           "invalidate", "load", "record_winner", "register_family",
+           "registered_families", "reset", "resolve", "save", "search",
+           "search_dispatches"]
 
 TUNE_FORMAT = "igg-tune-cache-v1"
 
@@ -92,6 +93,27 @@ _lock = threading.RLock()
 _CACHE: Dict[Tuple, Dict] = {}
 _LOADED: set = set()           # cache files already lazily loaded
 _SEARCH_DISPATCHES = 0         # timed search dispatches this process
+
+# Round 17: the search's hard-coded family tables (candidates_for /
+# _build_candidate) became a registration hook so spec-defined families
+# (igg.stencil) are searchable without editing this module.  An entry
+# supplies `candidates(grid, *, n_inner, interpret) -> [cand dicts]` and
+# `build(cand, *, n_inner, params, interpret) -> (state_fn, args)`; the
+# four built-ins stay in the static dispatch as the fallback.
+_FAMILY_REGISTRY: Dict[str, Dict] = {}
+
+
+def register_family(name: str, *, candidates, build) -> None:
+    """Register a family's autotune providers (idempotent;
+    `igg.stencil.compile` calls it for every compiled spec)."""
+    with _lock:
+        _FAMILY_REGISTRY[str(name)] = {"candidates": candidates,
+                                       "build": build}
+
+
+def registered_families() -> Dict[str, Dict]:
+    with _lock:
+        return dict(_FAMILY_REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +265,7 @@ def reset() -> None:
     with _lock:
         _CACHE.clear()
         _LOADED.clear()
+        _FAMILY_REGISTRY.clear()
         _SEARCH_DISPATCHES = 0
 
 
@@ -407,6 +430,10 @@ def candidates_for(family: str, *, n_inner: int = 8,
     from . import perf, shared
 
     grid = shared.global_grid()
+    reg = _FAMILY_REGISTRY.get(family)
+    if reg is not None:
+        return reg["candidates"](grid, n_inner=n_inner,
+                                 interpret=interpret)
     shape = (tuple(grid.nxyz[:2]) if family == "wave2d"
              else tuple(grid.nxyz))
     dtype = np.float32
@@ -457,8 +484,10 @@ def candidates_for(family: str, *, n_inner: int = 8,
                         "vmem_mb": None})
     else:
         raise GridError(
-            f"igg.autotune: unknown family {family!r} (known: "
-            f"diffusion3d, stokes3d, hm3d, wave2d).")
+            f"igg.autotune: unknown family {family!r} (built-ins: "
+            f"diffusion3d, stokes3d, hm3d, wave2d; registered: "
+            f"{sorted(_FAMILY_REGISTRY) or 'none'} — "
+            f"igg.autotune.register_family hooks new ones in).")
     return out
 
 
@@ -467,6 +496,10 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
     """(state_fn, args) for one candidate config: the family factory
     pinned to the candidate's tier/K/bx (``tune=False`` so the search
     never recurses into itself), on family-default f32 fields."""
+    reg = _FAMILY_REGISTRY.get(family)
+    if reg is not None:
+        return reg["build"](cand, n_inner=n_inner, params=params,
+                            interpret=interpret)
     tier = cand["tier"]
     fast = not tier.endswith(".xla")
     if family == "diffusion3d":

@@ -510,12 +510,14 @@ def recalibrate(family: str, tier: Optional[str] = None, *,
                 source: str = "heal") -> Optional[float]:
     """The drift loop's action (callable directly too): invalidate the
     family's ledger entries (:func:`igg.perf.invalidate`), re-measure —
-    :func:`igg.perf.calibrate` for the known model families (an AOT
-    slope-timed dispatch on the live grid), else re-anchor to the
-    freshest measured sample the ledger held — re-register the
-    prediction (:func:`igg.perf.predict`), and emit ``recalibrated``.
-    Returns the re-registered seconds/step (None when no measurement
-    exists to re-anchor to)."""
+    :func:`igg.perf.calibrate` for the known model families: the
+    built-ins AND anything hooked in via
+    :func:`igg.perf.register_family` (spec-defined `igg.stencil`
+    families among them — an AOT slope-timed dispatch on the live
+    grid), else re-anchor to the freshest measured sample the ledger
+    held — re-register the prediction (:func:`igg.perf.predict`), and
+    emit ``recalibrated``.  Returns the re-registered seconds/step
+    (None when no measurement exists to re-anchor to)."""
     from . import perf
 
     entries = perf.query(family, tier=tier)

@@ -6,6 +6,6 @@ modules so benchmarks, tests and the graft entry points share one
 implementation.
 """
 
-from . import diffusion3d, hm3d, stokes3d, wave2d
+from . import diffusion3d, hm3d, shallow_water, stokes3d, wave2d
 
-__all__ = ["diffusion3d", "hm3d", "stokes3d", "wave2d"]
+__all__ = ["diffusion3d", "hm3d", "shallow_water", "stokes3d", "wave2d"]

@@ -79,6 +79,28 @@ def fit_chunk_K(admissible: Callable[[int], object], kmax: int, *,
     return 0
 
 
+def whole_block_vmem(shapes, itemsize: int = 4) -> int:
+    """Modeled VMEM footprint of a whole-block/whole-window kernel
+    holding `shapes` in and out (trailing dims tile-padded to the
+    Mosaic (8, 128) tile, 2x margin for Mosaic scratch) — the one
+    footprint model the wave2d per-step/chunk gates and the
+    `igg.stencil` generated tiers share, kept next to the budget it is
+    compared against."""
+    from .chunk_engine import pad8, pad128
+
+    total = 0
+    for s in shapes:
+        padded = list(s)
+        padded[-1] = pad128(s[-1])
+        if len(s) >= 2:
+            padded[-2] = pad8(s[-2])
+        n = 1
+        for v in padded:
+            n *= int(v)
+        total += n
+    return int(2 * 2 * total * itemsize)
+
+
 def fit_bx(need_fn, bx: int, S0: int, S1: int, S2: int, *,
            min_bx: int, check_vmem: bool = True) -> int:
     """Largest slab height <= bx (halving, >= `min_bx`) that divides S0

@@ -344,14 +344,16 @@ def window_chunk_xla(fields, *, K, E, modes, grid, ols, shapes,
     whole-block arithmetic — interior updates, stale edges); then per-dim
     halo handling IN DIMENSION ORDER (later dims win shared cells, the
     per-step exchange-assembly order): wrap dims re-apply the per-field
-    staggered self-wrap, open dims re-freeze `freeze_fields`' shoulder+
-    boundary band from the chunk-entry buffers.  Returns the evolved
-    extended windows (central slicing is the caller's —
-    :func:`central_window`)."""
+    staggered self-wrap, open dims re-freeze the freeze set's shoulder+
+    boundary band from the chunk-entry buffers (`freeze_fields` may be a
+    uniform sequence or a per-dim dict — :func:`normalize_freeze`).
+    Returns the evolved extended windows (central slicing is the
+    caller's — :func:`central_window`)."""
     from jax import lax
 
     entry = tuple(fields)
     nd = fields[0].ndim
+    freeze = normalize_freeze(freeze_fields, nd)
 
     def step(_, S):
         S = list(core(*S))
@@ -361,7 +363,7 @@ def window_chunk_xla(fields, *, K, E, modes, grid, ols, shapes,
                     S[f] = wrap_edges(S[f], d, S[f].shape[d], ols[f][d])
             elif modes[d] in ("oext", "frozen"):
                 lo = E if modes[d] == "oext" else 0
-                for f in freeze_fields:
+                for f in freeze[d]:
                     hi = lo + shapes[f][d] - 1
                     S[f] = freeze_open_dim(S[f], entry[f], d, modes[d],
                                            lo, hi, grid)
@@ -391,6 +393,206 @@ def run_chunks(fields, *, n_inner, K, one_chunk):
     out = lax.fori_loop(0, chunks, lambda _, S: tuple(one_chunk(*S)),
                         tuple(fields))
     return (*out, chunks * K)
+
+
+# ---------------------------------------------------------------------------
+# The generic WHOLE-WINDOW resident Mosaic kernel (compiled realization)
+# ---------------------------------------------------------------------------
+
+def normalize_freeze(freeze_fields, nd):
+    """Per-dim freeze sets: a plain sequence applies to every dim (the
+    stokes convention — velocities frozen on all open dims); a dict
+    `{dim: (field indices)}` freezes per dim (a spec's face field is
+    no-write only along its staggered dim — `igg.stencil.analyze`)."""
+    if isinstance(freeze_fields, dict):
+        return {d: tuple(freeze_fields.get(d, ())) for d in range(nd)}
+    return {d: tuple(freeze_fields) for d in range(nd)}
+
+
+def _whole_window_kernel(*refs, K, cfg, core, nfr):
+    """Whole-window VMEM-resident chunk kernel (the wave2d scheme,
+    generalized): grid `(K,)`, ALL extended fields loaded into VMEM
+    scratch once, K coupled full-window steps evolved in place, written
+    back once — `n(R+W)/K` HBM traffic per step.  Per iteration the
+    per-dim halo handling runs in dimension order: wrap dims re-apply
+    the staggered self-wrap; open dims re-freeze the per-dim freeze
+    set's boundary PLANES from chunk-entry values, gated by the SMEM
+    edge flags (the plane-only freeze — the shoulder garbage beyond is
+    quarantined by the analyzer's boundary-validity recurrence, the
+    Stokes "one frozen plane" rule)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    modes, ols, ext_shapes, E = (cfg["modes"], cfg["ols"],
+                                 cfg["ext_shapes"], cfg["E"])
+    shapes = cfg["shapes"]
+    freeze = cfg["freeze"]
+    n = len(ext_shapes)
+    it = iter(refs)
+    text_hbm = [next(it) for _ in range(n)]
+    flags_ref = next(it) if nfr else None
+    fr_hbm = [next(it) for _ in range(nfr)]
+    outs = [next(it) for _ in range(n)]
+    fv = [next(it) for _ in range(n)]
+    fr_v = [next(it) for _ in range(nfr)]
+    lsem = next(it)
+    osem = next(it)
+    fsem = next(it) if nfr else None
+
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _():
+        cs = [pltpu.make_async_copy(text_hbm[j], fv[j], lsem.at[j])
+              for j in range(n)]
+        for c in cs:
+            c.start()
+        for c in cs:
+            c.wait()
+
+    if nfr:
+        @pl.when(k == 0)
+        def _():
+            cs = [pltpu.make_async_copy(fr_hbm[j], fr_v[j], fsem.at[j])
+                  for j in range(nfr)]
+            for c in cs:
+                c.start()
+            for c in cs:
+                c.wait()
+
+    fields = [fv[f][...] for f in range(n)]
+    news = list(core(*fields))
+    nd = fields[0].ndim
+    flags = ([flags_ref[j] for j in range(6)] if nfr else [0] * 6)
+    plane = {}
+    j = 0
+    for d in range(nd):
+        if modes[d] not in ("oext", "frozen"):
+            continue
+        for f in freeze[d]:
+            for side in (0, 1):
+                plane[(f, d, side)] = fr_v[j][...]
+                j += 1
+    for d in range(nd):
+        if modes[d] == "wrap":
+            for f in range(n):
+                news[f] = wrap_edges(news[f], d, ext_shapes[f][d],
+                                     ols[f][d])
+        elif modes[d] in ("oext", "frozen"):
+            lo = E if modes[d] == "oext" else 0
+            for f in freeze[d]:
+                hi = lo + shapes[f][d] - 1
+                idx = lax.broadcasted_iota(jnp.int32, news[f].shape, d)
+                p0 = jnp.expand_dims(plane[(f, d, 0)], d)
+                p1 = jnp.expand_dims(plane[(f, d, 1)], d)
+                news[f] = jnp.where((idx == lo) & (flags[2 * d] == 1),
+                                    p0, news[f])
+                news[f] = jnp.where((idx == hi) & (flags[2 * d + 1] == 1),
+                                    p1, news[f])
+    for f in range(n):
+        fv[f][...] = news[f]
+
+    @pl.when(k == K - 1)
+    def _():
+        cs = [pltpu.make_async_copy(fv[f], outs[f], osem.at[f])
+              for f in range(n)]
+        for c in cs:
+            c.start()
+        for c in cs:
+            c.wait()
+
+
+def whole_window_chunk_call(exts, *, K, E, modes, grid, ols, shapes,
+                            core, freeze_fields=(), window_fallback,
+                            interpret=False):
+    """Advance K coupled iterations on the extended buffers with the
+    whole-window resident kernel; returns every field's central local
+    block.  `core(*windows)` is the family's full-window arithmetic
+    (the same callable the pure-XLA window realization evolves);
+    `freeze_fields` the per-dim (or uniform) open-boundary no-write
+    set (:func:`normalize_freeze`).  In interpret mode the chunk runs
+    `window_fallback()` — the pure-XLA window realization — so CPU
+    meshes exercise the same admission gates and chunked-exchange
+    structure (the kernel's manual DMA is TPU-only)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nd = exts[0].ndim
+    ext_shapes = [tuple(x.shape) for x in exts]
+    freeze = normalize_freeze(freeze_fields, nd)
+
+    def central(F, f):
+        return central_window(F, shapes[f], E, modes)
+
+    if interpret:
+        out = window_fallback()
+        return tuple(central(F, f) for f, F in enumerate(out))
+
+    cfg = dict(modes=tuple(modes), ols=tuple(ols),
+               ext_shapes=tuple(ext_shapes), E=E,
+               shapes=tuple(shapes), freeze=freeze)
+
+    # Open-dim entry freeze planes + per-device SMEM edge flags (the
+    # resident_chunk_call pattern; "frozen" dims statically flag both
+    # sides, so 1-device frozen grids run under plain jax.jit).
+    fr_planes = []
+    flag_ops = []
+    any_open = any(modes[d] in ("oext", "frozen") for d in range(nd))
+    if any_open:
+        for d in range(nd):
+            if modes[d] not in ("oext", "frozen"):
+                continue
+            lo = E if modes[d] == "oext" else 0
+            for f in freeze[d]:
+                hi = lo + shapes[f][d] - 1
+                for idx in (lo, hi):
+                    p = jnp.squeeze(
+                        lax.slice_in_dim(exts[f], idx, idx + 1, axis=d), d)
+                    fr_planes.append(p)
+        # The kernel unpacks the SMEM flags operand iff freeze planes
+        # exist (its refs iterator is keyed on nfr): an open-dim spec
+        # whose per-dim freeze sets are all empty needs neither — the
+        # per-iteration freeze loop has nothing to gate.
+        if fr_planes:
+            flag_ops = [edge_flags(tuple(modes) + ("wrap",) * (3 - nd),
+                                   grid)]
+    nfr = len(fr_planes)
+
+    kern = partial(_whole_window_kernel, K=K, cfg=cfg, core=core, nfr=nfr)
+
+    operands = [*exts, *flag_ops, *fr_planes]
+    vmas = [getattr(getattr(x, "aval", None), "vma", None)
+            for x in operands]
+    vma = frozenset().union(*[v for v in vmas if v]) if any(vmas) else None
+
+    def shp(a):
+        return (jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+    out = pl.pallas_call(
+        kern,
+        grid=(K,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(exts)
+        + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(flag_ops)
+        + [pl.BlockSpec(memory_space=pl.ANY)] * nfr,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(exts),
+        out_shape=[shp(F) for F in exts],
+        input_output_aliases={f: f for f in range(len(exts))},
+        scratch_shapes=[pltpu.VMEM(F.shape, F.dtype) for F in exts]
+        + [pltpu.VMEM(p.shape, p.dtype) for p in fr_planes]
+        + [pltpu.SemaphoreType.DMA((len(exts),)),
+           pltpu.SemaphoreType.DMA((len(exts),))]
+        + ([pltpu.SemaphoreType.DMA((nfr,))] if nfr else []),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=128 * 1024 * 1024,
+            dimension_semantics=("arbitrary",)),
+    )(*operands)
+    return tuple(central(F, f) for f, F in enumerate(out))
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,12 @@
-"""Shared stencil-assembly utilities (plain XLA, model-agnostic)."""
+"""Shared stencil-ASSEMBLY utilities (plain XLA, model-agnostic).
+
+Naming note: this is `igg.ops.stencil` — low-level kernel/composition
+assembly helpers the hand-written models AND the `igg.stencil` lowering
+share.  The user-facing define-your-own-physics frontend is the PACKAGE
+`igg.stencil` (`from igg import stencil`); nothing is re-exported
+between the two, so the import direction is unambiguous — specs and
+compilation from `igg.stencil`, assembly helpers from `igg.ops`
+(`from igg.ops import interior_add`)."""
 
 from __future__ import annotations
 

@@ -45,9 +45,8 @@ from functools import partial
 
 from ._vmem import chunk_budget, fit_chunk_K
 from .chunk_engine import (admit_chunk_common, admit_send_slabs, dim_modes,
-                           central_window, extend_fields, field_ols,
-                           pad8, pad128, run_chunks, window_chunk_xla,
-                           wrap_edges)
+                           extend_fields, field_ols, run_chunks,
+                           whole_window_chunk_call, window_chunk_xla)
 
 
 def _field_shapes(shape):
@@ -71,10 +70,11 @@ def _compute(P, Vx, Vy, *, dx, dy, dt, rho, bulk):
 # ---------------------------------------------------------------------------
 
 def _whole_block_vmem(shapes, itemsize: int = 4) -> int:
-    """Modeled VMEM footprint of a whole-block 2-D kernel holding
-    `shapes` in and out (tile-padded, 2x margin for Mosaic scratch)."""
-    return int(2 * 2 * sum(pad8(a) * pad128(b) for a, b in shapes)
-               * itemsize)
+    """The shared whole-block footprint model (round 17: moved next to
+    the budget it gates, `igg.ops._vmem.whole_block_vmem`)."""
+    from ._vmem import whole_block_vmem
+
+    return whole_block_vmem(shapes, itemsize)
 
 
 def wave2d_pallas_supported(grid, P, interpret: bool = False):
@@ -252,100 +252,22 @@ def _window_steps_xla(Pe, Vxe, Vye, *, Kc, E, modes, grid, kw, ols,
                             freeze_fields=(), core=_window_core(kw))
 
 
-def _chunk_kernel(*refs, Kc, cfg, kw):
-    """Whole-window VMEM-resident chunk kernel: grid `(Kc,)`, all three
-    extended fields loaded into VMEM scratch once, Kc coupled steps
-    evolved in place (full-window values — 2-D fields are plane-sized),
-    written back once.  Periodic modes only: the per-step halo handling
-    degenerates to the staggered self-wrap on wrap dims (extended dims
-    evolve naturally)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    modes, ols, ext_shapes = cfg["modes"], cfg["ols"], cfg["ext_shapes"]
-    it = iter(refs)
-    text_hbm = [next(it) for _ in range(3)]
-    outs = [next(it) for _ in range(3)]
-    fv = [next(it) for _ in range(3)]
-    lsem = next(it)
-    osem = next(it)
-
-    k = pl.program_id(0)
-
-    @pl.when(k == 0)
-    def _():
-        cs = [pltpu.make_async_copy(text_hbm[j], fv[j], lsem.at[j])
-              for j in range(3)]
-        for c in cs:
-            c.start()
-        for c in cs:
-            c.wait()
-
-    fields = [fv[f][...] for f in range(3)]
-    news = list(_compute(*fields, **kw))
-    for d in range(2):
-        if modes[d] == "wrap":
-            for f in range(3):
-                news[f] = wrap_edges(news[f], d, ext_shapes[f][d],
-                                     ols[f][d])
-    for f in range(3):
-        fv[f][...] = news[f]
-
-    @pl.when(k == Kc - 1)
-    def _():
-        cs = [pltpu.make_async_copy(fv[f], outs[f], osem.at[f])
-              for f in range(3)]
-        for c in cs:
-            c.start()
-        for c in cs:
-            c.wait()
-
-
 def _chunk_call(exts, *, Kc, modes, grid, kw, ols, shapes,
                 interpret=False):
     """Advance Kc coupled steps on the extended buffers; returns the
-    three central local blocks."""
-    import jax
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+    three central local blocks.  Round 17: the whole-window resident
+    kernel moved into the chunk engine (`whole_window_chunk_call` — the
+    same grid-`(Kc,)` scheme, generalized to N fields and open-dim
+    freeze planes so `igg.stencil`'s generated chunk tiers instantiate
+    it too); wave2d passes its proven periodic-only config."""
     E = 2 * Kc
-    ext_shapes = [tuple(x.shape) for x in exts]
-
-    def central(F, f):
-        return central_window(F, shapes[f], E, modes)
-
-    if interpret:
-        out = _window_steps_xla(*exts, Kc=Kc, E=E, modes=modes, grid=grid,
-                                kw=kw, ols=ols, shapes=shapes)
-        return tuple(central(F, f) for f, F in enumerate(out))
-
-    cfg = dict(modes=tuple(modes), ols=tuple(ols),
-               ext_shapes=tuple(ext_shapes))
-    kern = partial(_chunk_kernel, Kc=Kc, cfg=cfg, kw=kw)
-
-    vmas = [getattr(getattr(x, "aval", None), "vma", None) for x in exts]
-    vma = frozenset().union(*[v for v in vmas if v])
-
-    def shp(a):
-        return (jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma) if vma
-                else jax.ShapeDtypeStruct(a.shape, a.dtype))
-
-    out = pl.pallas_call(
-        kern,
-        grid=(Kc,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
-        out_shape=[shp(F) for F in exts],
-        input_output_aliases={0: 0, 1: 1, 2: 2},
-        scratch_shapes=[pltpu.VMEM(F.shape, F.dtype) for F in exts]
-        + [pltpu.SemaphoreType.DMA((3,)),
-           pltpu.SemaphoreType.DMA((3,))],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=128 * 1024 * 1024,
-            dimension_semantics=("arbitrary",)),
-    )(*exts)
-    return tuple(central(F, f) for f, F in enumerate(out))
+    return whole_window_chunk_call(
+        list(exts), K=Kc, E=E, modes=modes, grid=grid, ols=ols,
+        shapes=shapes, core=_window_core(kw), freeze_fields=(),
+        window_fallback=lambda: _window_steps_xla(
+            *exts, Kc=Kc, E=E, modes=modes, grid=grid, kw=kw, ols=ols,
+            shapes=shapes),
+        interpret=interpret)
 
 
 def fused_wave2d_chunk_steps(P, Vx, Vy, *, n_inner: int, K: int,

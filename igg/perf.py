@@ -84,7 +84,8 @@ __all__ = [
     "calibrate", "observe_window", "window_state", "sample_context",
     "device_context", "bytes_per_step", "hbm_peak_gbps", "save", "load",
     "merge_ledgers", "reset", "invalidate", "forget_prediction",
-    "compare_rows", "compare_paths", "LEDGER_FORMAT",
+    "compare_rows", "compare_paths", "register_family",
+    "registered_families", "LEDGER_FORMAT",
 ]
 
 LEDGER_FORMAT = "igg-perf-ledger-v1"
@@ -144,6 +145,38 @@ def ledger_path() -> Optional[pathlib.Path]:
 #   wave2d:      read P,Vx,Vy, write P,Vx,Vy               -> 6 accesses
 _FAMILY_ACCESSES = {"diffusion3d": 3, "stokes3d": 9, "hm3d": 4, "wave2d": 6}
 
+# Round 17: the hard-coded family table above became a REGISTRATION HOOK
+# so spec-defined families (igg.stencil) get roofline gauges, drift
+# detection, and heal-loop re-calibration without editing this module.
+# `register_family(name, accesses=..., steps=...)` supplies the analytic
+# accesses count (the stencil analyzer derives it from the read-set) and
+# an optional `steps(dtype) -> (state_fn, args)` builder consulted by
+# :func:`calibrate`; the four built-ins stay in the tables as the
+# fallback, registry entries win.
+_FAMILY_REGISTRY: Dict[str, Dict] = {}
+
+
+def register_family(name: str, *, accesses: Optional[int] = None,
+                    steps=None) -> None:
+    """Register (or update) a model family with the perf layer:
+    `accesses` feeds :func:`bytes_per_step`'s roofline model, `steps`
+    (a `(dtype) -> (state_fn, args)` builder on the live grid) makes
+    :func:`calibrate`'s named-family convenience — and with it the heal
+    loop's drift→recalibrate action — work for the family.  Idempotent;
+    `igg.stencil.compile` calls it for every compiled spec."""
+    with _lock:
+        _FAMILY_REGISTRY[str(name)] = {
+            "accesses": int(accesses) if accesses is not None else None,
+            "steps": steps,
+        }
+
+
+def registered_families() -> Dict[str, Dict]:
+    """The registered-family table (name -> {accesses, steps}); the
+    built-in families live in the static fallback tables, not here."""
+    with _lock:
+        return dict(_FAMILY_REGISTRY)
+
 # Peak HBM bandwidth per chip, GB/s (published per-chip figures; matched
 # by substring against the lowercased jax `device_kind`).  The K-step
 # trapezoid tiers read/write once per K steps, so the per-step model
@@ -161,10 +194,12 @@ def bytes_per_step(family: str, tier: Optional[str], local_shape,
     `local_shape` block of `dtype` — logical bytes, the ideal-fusion
     model.  None when no model applies (unknown family, a K-step
     trapezoid tier whose traffic is amortized over K, or no shape)."""
-    acc = _FAMILY_ACCESSES.get(family)
+    reg = _FAMILY_REGISTRY.get(family)
+    acc = (reg["accesses"] if reg and reg.get("accesses") is not None
+           else _FAMILY_ACCESSES.get(family))
     if acc is None or not local_shape:
         return None
-    if tier and "trapezoid" in tier:
+    if tier and ("trapezoid" in tier or tier.endswith(".chunk")):
         return None
     try:
         itemsize = np.dtype(dtype).itemsize
@@ -422,6 +457,7 @@ def reset() -> None:
         _PREDICTIONS.clear()
         _DRIFT_EMITTED.clear()
         _PERSISTED.clear()
+        _FAMILY_REGISTRY.clear()
         _last_save = 0.0
 
 
@@ -516,7 +552,12 @@ def _default_family_step(family: str, dtype):
     """(state_fn, args) for a named model family's default step on the
     live grid — the convenience behind ``calibrate("diffusion3d")``.
     `state_fn` maps args to same-structured outputs (the
-    `igg.time_steps` contract); pass-through coefficients ride along."""
+    `igg.time_steps` contract); pass-through coefficients ride along.
+    Registered families (:func:`register_family` — spec-defined physics
+    among them) resolve through their registered builder first."""
+    reg = _FAMILY_REGISTRY.get(family)
+    if reg is not None and reg.get("steps") is not None:
+        return reg["steps"](dtype)
     if family == "diffusion3d":
         from .models import diffusion3d as m
 
@@ -545,9 +586,11 @@ def _default_family_step(family: str, dtype):
         step = m.make_step(m.Params(), donate=False)
         return (lambda P, Vx, Vy: step(P, Vx, Vy)), tuple(fields)
     raise GridError(
-        f"igg.perf.calibrate: unknown family {family!r} (known: "
-        f"diffusion3d, hm3d, stokes3d, wave2d; pass a step callable + "
-        f"args for anything else).")
+        f"igg.perf.calibrate: unknown family {family!r} (built-ins: "
+        f"diffusion3d, hm3d, stokes3d, wave2d; registered: "
+        f"{sorted(_FAMILY_REGISTRY) or 'none'}; pass a step callable + "
+        f"args for anything else, or register via "
+        f"igg.perf.register_family).")
 
 
 def calibrate(model, args=None, *, family: Optional[str] = None,

@@ -1,0 +1,32 @@
+"""`igg.stencil` — the define-your-own-physics frontend.
+
+Model-as-data on TPU (the TPU-CFD exemplar, PAPERS 2108.11076): users
+declare fields, update expressions, and boundary conditions as a
+:class:`StencilSpec`; :func:`compile` lowers the spec onto the existing
+tier ladder — a generated pure-XLA composition truth, a generated
+per-step Mosaic tier, and a generated K-step temporal-blocking tier on
+the shared chunk engine — each Admission-gated, verify-on-first-use-
+guarded, and quarantinable, so user physics rides the same degradation,
+resilience, observability, autotuning, and fleet machinery as the
+built-in families.  `tests/test_stencil.py` pins the whole story:
+spec-compiled wave2d is BITWISE the hand-written module, and the
+BASELINE shallow-water family is pure frontend input.
+
+Naming note (the `igg/ops/stencil.py` collision): `from igg import
+stencil` is THIS package — the user-facing frontend.  The module
+`igg.ops.stencil` is the lowering's shared assembly utilities
+(`interior_add`), reached as `from igg.ops import interior_add`;
+nothing is re-exported across the two, so the import direction is
+always unambiguous: specs and compilation from `igg.stencil`, kernel
+assembly helpers from `igg.ops`.
+"""
+
+from .analyze import Analysis, admissible, analyze
+from .compile import compile
+from .library import shallow_water_spec, wave2d_coeffs, wave2d_spec
+from .lower import local_step_fn
+from .spec import Field, Param, StencilSpec, Update, where
+
+__all__ = ["Analysis", "Field", "Param", "StencilSpec", "Update",
+           "admissible", "analyze", "compile", "local_step_fn",
+           "shallow_water_spec", "wave2d_coeffs", "wave2d_spec", "where"]

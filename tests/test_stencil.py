@@ -1,0 +1,560 @@
+"""igg.stencil — the define-your-own-physics frontend.
+
+Three stories, each pinned on the 8-device CPU mesh:
+
+1. **The wave2d mirror is the hand-written module.**  The spec in
+   `igg.stencil.library.wave2d_spec` mirrors `igg/models/wave2d.py`
+   expression-for-expression; the generated XLA truth and Mosaic tiers
+   must be BITWISE the hand ladder's on periodic, open, and mixed
+   meshes, and the generated chunk tier bitwise the composition on
+   periodic meshes (open-dim chunks — a rung the hand ladder refuses —
+   are held to the repo's chunk tolerance, rel < 2e-5 of field scale).
+2. **The analyzer derives what the trapezoid modules hand-derive.**
+   Read radii, the chunk margin E (the exact recurrence shows the
+   hand-written `E = 2K` was conservative), per-dim freeze sets, the
+   perf accesses count — and every refusal (unsupported BC, oversized
+   read radius, f64-on-Mosaic) surfaces as a structured Admission.
+3. **Shallow water is pure frontend input** with the full production
+   surface: ladder dispatch, verify-on-first-use quarantine of a
+   chaos-corrupted generated kernel with bit-exact XLA fallback under
+   `run_resilient`, ensemble membership, halo agreement on the
+   staggered fields, and perf/autotune registration.
+"""
+
+import numpy as np
+import pytest
+
+import igg
+from igg import stencil
+from igg.models import shallow_water as sw
+from igg.models import wave2d
+
+from helpers import assert_halo_agreement
+
+
+def _wave_setup(dtype=np.float32):
+    params = wave2d.Params()
+    state0 = wave2d.init_fields(params, dtype=dtype)
+    return params, state0, stencil.wave2d_coeffs(params)
+
+
+# ---------------------------------------------------------------------------
+# Spec / algebra validation
+# ---------------------------------------------------------------------------
+
+def test_spec_validation_errors():
+    F = stencil.Field("F", stagger=(0, 0))
+    G = stencil.Field("G", stagger=(0, 0))
+    with pytest.raises(igg.GridError, match="undeclared field"):
+        stencil.StencilSpec("s", fields=[F],
+                            updates=[stencil.Update(F, G[0, 0])])
+    with pytest.raises(igg.GridError, match="stagger"):
+        stencil.Field("bad", stagger=(2, 0))
+    with pytest.raises(igg.GridError, match="1-D offset"):
+        F.shift(1)
+    with pytest.raises(igg.GridError, match="no updates"):
+        stencil.StencilSpec("s", fields=[F], updates=[])
+    with pytest.raises(igg.GridError, match="twice"):
+        stencil.StencilSpec("s", fields=[F], updates=[
+            stencil.Update(F, F[0, 0]), stencil.Update(F, F[0, 0])])
+    spec = stencil.StencilSpec("s", fields=[F],
+                               updates=[stencil.Update(F, F[0, 0],
+                                                       mode="assign")],
+                               params=[stencil.Param("a")])
+    with pytest.raises(igg.GridError, match="no value"):
+        spec.coeffs()
+    with pytest.raises(igg.GridError, match="unknown coeffs"):
+        spec.coeffs({"a": 1.0, "zz": 2.0})
+
+
+def test_eq_ne_are_traced_comparisons():
+    """`F == x` must build a mask, not a host bool (a bool would
+    constant-fold the where on every rung — silently wrong physics the
+    verify guard could never catch, since the truth rung would be
+    equally wrong)."""
+    from igg.stencil.spec import BinOp
+
+    F = stencil.Field("F", stagger=(0, 0))
+    e = F[0, 0] == 0
+    assert isinstance(e, BinOp) and e.op == "eq"
+    n = F[0, 0] != 0
+    assert isinstance(n, BinOp) and n.op == "ne"
+    # identity hash survives the traced __eq__ (specs key caches by it)
+    assert len({F, stencil.Param("p")}) == 2
+
+
+def test_where_mask_lowers():
+    """The where/comparison algebra: a clamped relaxation spec runs and
+    clamps (value-level check of the generated XLA composition)."""
+    F = stencil.Field("F", stagger=(0, 0))
+    r = stencil.Param("r", default=0.25)
+    lap = (F[-1, 0] + F[1, 0] + F[0, -1] + F[0, 1] - 4.0 * F[0, 0])
+    expr = stencil.where(F[0, 0] > 0.5, 0.0 * F[0, 0], r * lap)
+    spec = stencil.StencilSpec("clamped", fields=[F],
+                               updates=[stencil.Update(F, expr,
+                                                       pad=((1, 1),
+                                                            (1, 1)))],
+                               params=[r])
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1, quiet=True)
+    A = igg.update_halo(igg.zeros((6, 6)) + 0.6)
+    step = stencil.compile(spec, donate=False, use_pallas=False)
+    (out,) = step(A)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(A))
+
+
+# ---------------------------------------------------------------------------
+# The analyzer
+# ---------------------------------------------------------------------------
+
+def test_analyzer_wave2d_facts():
+    a = stencil.analyze(stencil.wave2d_spec())
+    assert a.halo_radius == (1, 1)
+    assert a.accesses == 6         # reads P,Vx,Vy + writes P,Vx,Vy
+    # Per-dim freeze sets: each face field is no-write only along its
+    # staggered dim (P's computed boundary IS its value).
+    assert a.freeze == {0: (1,), 1: (2,)}
+    # The exact margin recurrence: the coupled chain loses ONE row of
+    # validity per side per step (the hand-derived wave2d E=2K is 2x
+    # conservative).
+    assert [a.margin_after(K) for K in (1, 2, 4, 8)] == [1, 2, 4, 8]
+    assert a.open_chunk_ok(4)
+
+
+def test_analyzer_margin_tightness_empirical(eight_devices):
+    """E = margin_after(K) is exactly tight: one row less and the chunk
+    evolution serves stale cells (rel error far beyond tolerance)."""
+    from igg.stencil.analyze import Analysis
+
+    orig = Analysis.margin_after
+    igg.init_global_grid(16, 16, 1, periodx=1, periody=1, quiet=True)
+    params, state0, cf = _wave_setup()
+    spec = stencil.wave2d_spec()
+    ref = wave2d.make_step(params, donate=False, n_inner=5,
+                           use_pallas=False)(*state0)
+    try:
+        Analysis.margin_after = lambda self, K: max(1, orig(self, K) - 1)
+        out = stencil.compile(spec, coeffs=cf, donate=False, n_inner=5,
+                              use_pallas=True, pallas_interpret=True,
+                              chunk=True, K=4)(*state0)
+    finally:
+        Analysis.margin_after = orig
+    rel = max(
+        float(np.abs(np.asarray(r, np.float64)
+                     - np.asarray(o, np.float64)).max()
+              / (np.abs(np.asarray(r, np.float64)).max() + 1e-30))
+        for r, o in zip(ref, out))
+    assert rel > 1e-6, rel
+
+
+def test_analyzer_open_recurrence_refuses_self_negative_assign():
+    """An assign field reading ITSELF at a negative offset cannot keep a
+    valid computed boundary (its boundary row would read shoulder
+    garbage) — the boundary-validity recurrence must refuse open
+    chunks for it."""
+    F = stencil.Field("F", stagger=(0, 0))
+    spec = stencil.StencilSpec(
+        "drift", fields=[F],
+        updates=[stencil.Update(F, F[-1, 0], mode="assign")])
+    a = stencil.analyze(spec)
+    assert not a.open_chunk_ok(2)
+
+
+# ---------------------------------------------------------------------------
+# Gate matrix: every analyzer refusal is a structured Admission
+# ---------------------------------------------------------------------------
+
+def test_gate_unsupported_bc():
+    F = stencil.Field("F", stagger=(0, 0))
+    spec = stencil.StencilSpec(
+        "s", fields=[F], bc=("reflect", "periodic"),
+        updates=[stencil.Update(F, F[0, 0], mode="assign")])
+    adm = stencil.admissible(spec)
+    assert not adm and "unsupported boundary condition" in adm.reason
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1, quiet=True)
+    with pytest.raises(igg.GridError, match="unsupported boundary"):
+        stencil.compile(spec)
+
+
+def test_gate_bc_grid_mismatch():
+    F = stencil.Field("F", stagger=(0, 0))
+    spec = stencil.StencilSpec(
+        "s", fields=[F], bc=("periodic", "any"),
+        updates=[stencil.Update(F, F[0, 0], mode="assign")])
+    igg.init_global_grid(6, 6, 1, quiet=True)    # all open
+    adm = stencil.admissible(spec)
+    assert not adm and "requires a periodic dim 0" in adm.reason
+
+
+def test_gate_oversized_read_radius():
+    F = stencil.Field("F", stagger=(0, 0))
+    spec = stencil.StencilSpec(
+        "wide", fields=[F],
+        updates=[stencil.Update(F, F[-2, 0] + F[2, 0],
+                                pad=((2, 2), (0, 0)))])
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1, quiet=True)
+    adm = stencil.admissible(spec)
+    assert not adm and "oversized read radius" in adm.reason
+    assert "overlap >= 3" in adm.reason
+    with pytest.raises(igg.GridError, match="oversized read radius"):
+        stencil.compile(spec)
+    # ... and an overlap-3 grid admits it.
+    igg.finalize_global_grid()
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1,
+                         overlapx=3, overlapy=3, quiet=True)
+    assert stencil.admissible(spec)
+
+
+def test_gate_read_outside_write_region():
+    """A read reaching below the write-region origin (or past the
+    source's top) refuses with a structured reason instead of dying in
+    tracing with an opaque empty-slice shape error."""
+    F = stencil.Field("F", stagger=(0, 0))
+    spec = stencil.StencilSpec(
+        "drift", fields=[F],
+        updates=[stencil.Update(F, F[-1, 0], mode="assign")])
+    adm = stencil.admissible(spec)
+    assert not adm and "outside the source array" in adm.reason
+    assert "[0, 0]" in adm.reason     # assign: offsets must be 0 here
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1, quiet=True)
+    with pytest.raises(igg.GridError, match="outside the source array"):
+        stencil.compile(spec)
+    # ...while the pad of an 'add' update widens the legal range: the
+    # wave2d velocity read P[-1, 0] under pad ((1,1),(0,0)) admits.
+    assert stencil.admissible(stencil.wave2d_spec())
+
+
+def test_gate_f64_refuses_mosaic_serves_truth(eight_devices):
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    params, _, cf = _wave_setup()
+    state64 = wave2d.init_fields(params, dtype=np.float64)
+    step = stencil.compile(stencil.wave2d_spec(), coeffs=cf, donate=False,
+                           use_pallas="auto", pallas_interpret=True)
+    out = step(*state64)
+    assert igg.degrade.active().get("wave2d_spec") == "wave2d_spec.xla"
+    assert "float64" in igg.degrade.admission_log().get(
+        "wave2d_spec.mosaic", "")
+    assert all(np.isfinite(np.asarray(o)).all() for o in out)
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: spec-compiled wave2d vs the hand-written ladder
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("periods", [(1, 1), (0, 0), (1, 0)],
+                         ids=["periodic", "open", "mixed"])
+def test_wave2d_spec_matches_hand_ladder(eight_devices, periods):
+    """All rungs, 8-device mesh: spec xla == hand xla and spec mosaic ==
+    hand mosaic BITWISE; the generated chunk tier is bitwise the
+    composition on the periodic mesh (one warm-up + K=4 chunk +
+    remainder) and tolerance-equal on open/mixed (the hand ladder has
+    no open-chunk rung; 1-ulp f32 cancellation at the frozen
+    boundaries)."""
+    igg.init_global_grid(8, 8, 1, periodx=periods[0], periody=periods[1],
+                         quiet=True)
+    params, state0, cf = _wave_setup()
+    spec = stencil.wave2d_spec()
+    n_inner = 7
+    hand_xla = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                                use_pallas=False)
+    hand_mosaic = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                                   use_pallas=True, pallas_interpret=True,
+                                   chunk=False)
+    ref = hand_xla(*state0)
+
+    s_xla = stencil.compile(spec, coeffs=cf, donate=False,
+                            n_inner=n_inner, use_pallas=False)(*state0)
+    assert igg.degrade.active()["wave2d_spec"] == "wave2d_spec.xla"
+    for r, o, n in zip(ref, s_xla, "P Vx Vy".split()):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(o),
+                                      err_msg=f"xla/{n}")
+
+    s_mos = stencil.compile(spec, coeffs=cf, donate=False,
+                            n_inner=n_inner, use_pallas=True,
+                            pallas_interpret=True, chunk=False)(*state0)
+    assert igg.degrade.active()["wave2d_spec"] == "wave2d_spec.mosaic"
+    hm = hand_mosaic(*state0)
+    for r, o, n in zip(hm, s_mos, "P Vx Vy".split()):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(o),
+                                      err_msg=f"mosaic/{n}")
+
+    s_chk = stencil.compile(spec, coeffs=cf, donate=False,
+                            n_inner=n_inner, use_pallas=True,
+                            pallas_interpret=True, chunk=True,
+                            K=4)(*state0)
+    assert igg.degrade.active()["wave2d_spec"] == "wave2d_spec.chunk"
+    for r, o, n in zip(ref, s_chk, "P Vx Vy".split()):
+        if periods == (1, 1):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(o),
+                                          err_msg=f"chunk/{n}")
+        else:
+            a = np.asarray(r, np.float64)
+            b = np.asarray(o, np.float64)
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < 2e-5, (n, rel)
+
+
+def test_wave2d_spec_chunk_matches_hand_chunk(eight_devices):
+    """Where BOTH ladders serve a chunk rung (periodic, 16^2 blocks so
+    the hand tier's E=2K slabs fit), the two chunk tiers agree bitwise
+    with the composition and with each other."""
+    igg.init_global_grid(16, 16, 1, periodx=1, periody=1, quiet=True)
+    params, state0, cf = _wave_setup()
+    n_inner = 5
+    ref = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                           use_pallas=False)(*state0)
+    hand = wave2d.make_step(params, donate=False, n_inner=n_inner,
+                            use_pallas=True, pallas_interpret=True,
+                            chunk=True, K=4)(*state0)
+    assert igg.degrade.active()["wave2d"] == "wave2d.chunk"
+    spec_c = stencil.compile(stencil.wave2d_spec(), coeffs=cf,
+                             donate=False, n_inner=n_inner,
+                             use_pallas=True, pallas_interpret=True,
+                             chunk=True, K=4)(*state0)
+    assert igg.degrade.active()["wave2d_spec"] == "wave2d_spec.chunk"
+    for r, h, o, n in zip(ref, hand, spec_c, "P Vx Vy".split()):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(h),
+                                      err_msg=f"hand/{n}")
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(o),
+                                      err_msg=f"spec/{n}")
+
+
+def test_spec_halo_agreement_staggered(eight_devices):
+    """Post-step halo agreement on every spec-compiled staggered field
+    (the overlap cells equal the owning neighbor's interior — the
+    invariant verify-on-first-use leans on)."""
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    p = sw.Params()
+    state = sw.init_fields(p)
+    step = sw.make_step(p, donate=False, use_pallas=True,
+                        pallas_interpret=True)
+    for _ in range(3):
+        state = step(*state)
+    for a, ls in zip(state, ((8, 8), (9, 8), (8, 9))):
+        assert_halo_agreement(np.asarray(a), ls)
+
+
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0)],
+                         ids=["periodic", "open"])
+def test_rank3_spec_matches_hand_composition(eight_devices, periods):
+    """The frontend is not 2-D-only: a 3-D radius-1 relaxation spec is
+    bitwise the hand-written local-step composition on the (2,2,2)
+    mesh, on every rung that admits."""
+    from igg.ops import interior_add
+
+    igg.init_global_grid(6, 6, 6, periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    T = stencil.Field("T", stagger=(0, 0, 0))
+    r = stencil.Param("r", default=0.1)
+    lap = (T[-1, 0, 0] + T[1, 0, 0] + T[0, -1, 0] + T[0, 1, 0]
+           + T[0, 0, -1] + T[0, 0, 1] - 6.0 * T[0, 0, 0])
+    spec = stencil.StencilSpec(
+        "relax3d", fields=[T], params=[r],
+        updates=[stencil.Update(T, r * lap, pad=((1, 1),) * 3)])
+
+    def local_step(A):
+        lap = (A[:-2, 1:-1, 1:-1] + A[2:, 1:-1, 1:-1]
+               + A[1:-1, :-2, 1:-1] + A[1:-1, 2:, 1:-1]
+               + A[1:-1, 1:-1, :-2] + A[1:-1, 1:-1, 2:]
+               - 6.0 * A[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(interior_add(A, 0.1 * lap))
+
+    import numpy as _np
+    rng = _np.random.default_rng(7)
+    A0 = igg.update_halo(igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (6, 6, 6),
+        dtype=np.float32))
+    hand = igg.sharded(lambda A: __import__("jax").lax.fori_loop(
+        0, 5, lambda _, S: local_step(S), A))
+    ref = hand(A0)
+    for kw, name in ((dict(use_pallas=False), "xla"),
+                     (dict(use_pallas=True, pallas_interpret=True,
+                           chunk=False), "mosaic")):
+        step = stencil.compile(spec, donate=False, n_inner=5, **kw)
+        (out,) = step(A0)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                      err_msg=name)
+    assert igg.degrade.active()["relax3d"] == "relax3d.mosaic"
+
+
+# ---------------------------------------------------------------------------
+# Shallow water: the BASELINE family as pure frontend input
+# ---------------------------------------------------------------------------
+
+def test_shallow_water_decomposition_invariance(eight_devices):
+    def run(nx, ny, nt, **kw):
+        igg.init_global_grid(nx, ny, 1, periodx=1, periody=1, quiet=True,
+                             **kw)
+        p = sw.Params()
+        state = sw.init_fields(p, dtype=np.float64)
+        step = sw.make_step(p, donate=False)
+        for _ in range(nt):
+            state = step(*state)
+        out = tuple(np.asarray(igg.gather_interior(a)) for a in state)
+        igg.finalize_global_grid()
+        return out
+
+    multi = run(6, 6, 20)                       # (4,2,1) decomposition
+    single = run(18, 10, 20, dimx=1, dimy=1, dimz=1)
+    for m, s, name in zip(multi, single, "h hu hv".split()):
+        assert m.shape == s.shape, name
+        np.testing.assert_allclose(m, s, atol=1e-12, err_msg=name)
+
+
+def test_shallow_water_mass_conserved_and_tiers(eight_devices):
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    p = sw.Params()
+    state = sw.init_fields(p)
+    mass0 = float(np.sum(np.asarray(igg.gather_interior(state[0]),
+                                    np.float64)))
+    step = sw.make_step(p, donate=False, use_pallas=True,
+                        pallas_interpret=True)
+    for _ in range(30):
+        state = step(*state)
+    assert igg.degrade.active()["shallow_water"] == "shallow_water.mosaic"
+    mass1 = float(np.sum(np.asarray(igg.gather_interior(state[0]),
+                                    np.float64)))
+    assert abs(mass1 - mass0) / abs(mass0) < 1e-6   # periodic continuity
+    assert np.isfinite(np.asarray(state[0])).all()
+    # chunk rung serves too, tolerance-equal to the truth
+    ref = sw.make_step(p, donate=False, n_inner=5,
+                       use_pallas=False)(*sw.init_fields(p))
+    chk = sw.make_step(p, donate=False, n_inner=5, use_pallas=True,
+                       pallas_interpret=True, chunk=True,
+                       K=4)(*sw.init_fields(p))
+    assert igg.degrade.active()["shallow_water"] == "shallow_water.chunk"
+    for r, o, n in zip(ref, chk, "h hu hv".split()):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(o),
+                                      err_msg=n)
+
+
+def test_shallow_water_friction_damps(eight_devices):
+    """The cf friction term (a self-read in an add update — algebra
+    beyond the wave2d mirror) dissipates energy."""
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+
+    def energy(params, nt=40):
+        state = sw.init_fields(params, dtype=np.float64)
+        step = sw.make_step(params, donate=False)
+        for _ in range(nt):
+            state = step(*state)
+        return sum(float(np.sum(np.asarray(a, np.float64) ** 2))
+                   for a in state)
+
+    free = energy(sw.Params())
+    damped = energy(sw.Params(cf=0.5))
+    assert damped < free
+
+
+def test_shallow_water_resilient_chaos_quarantine(eight_devices, tmp_path):
+    """The acceptance loop: run_resilient + chaos-corrupted GENERATED
+    mosaic kernel -> verify-on-first-use refusal -> quarantine -> the
+    run finishes bit-exact to the generated XLA truth with zero
+    recovery code."""
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    p = sw.Params()
+    h, hu, hv = sw.init_fields(p)
+    ref_step = sw.make_step(p, donate=False, use_pallas=False)
+    ref = (h, hu, hv)
+    for _ in range(10):
+        ref = ref_step(*ref)
+    igg.degrade.reset()
+
+    def wrap(step):
+        def fn(st):
+            return dict(zip(("h", "hu", "hv"),
+                            step(st["h"], st["hu"], st["hv"])))
+        return fn
+
+    with igg.chaos.armed(igg.chaos.kernel_corrupt("shallow_water.mosaic",
+                                                  1e3)):
+        bad = sw.make_step(p, donate=False, use_pallas="auto",
+                           pallas_interpret=True, verify="first_use")
+        res = igg.run_resilient(wrap(bad), dict(h=h, hu=hu, hv=hv), 10,
+                                checkpoint_dir=str(tmp_path),
+                                watch_every=5)
+    q = igg.degrade.status()
+    assert q["shallow_water.mosaic"].reason == "verify_mismatch"
+    assert igg.degrade.active()["shallow_water"] == "shallow_water.xla"
+    for r, k in zip(ref, ("h", "hu", "hv")):
+        np.testing.assert_array_equal(np.asarray(r),
+                                      np.asarray(res.state[k]), err_msg=k)
+
+
+def test_shallow_water_ensemble_member(eight_devices):
+    """Spec-compiled physics as run_ensemble members: the spec's LOCAL
+    step (igg.stencil.local_step_fn) vmapped over the member axis."""
+    p = sw.Params(lx=10.0, ly=10.0)
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    spec = sw.spec(p)
+    local = stencil.local_step_fn(spec, p.coeffs())
+
+    def member_step(st):
+        h, hu, hv = local(st["h"], st["hu"], st["hv"])
+        return dict(h=h, hu=hu, hv=hv)
+
+    states = []
+    for m in range(2):
+        h, hu, hv = sw.init_fields(p, dtype=np.float64)
+        states.append(dict(h=h * (1.0 + m), hu=hu, hv=hv))
+    res = igg.run_ensemble(member_step, states, 5, watch_every=2)
+    assert res.steps_done == 5
+    assert not res.quarantined
+    for m in range(2):
+        st = res.member_state(m)
+        assert np.isfinite(np.asarray(st["h"])).all()
+
+
+# ---------------------------------------------------------------------------
+# Registration: perf + autotune treat spec families like built-ins
+# ---------------------------------------------------------------------------
+
+def test_perf_registration_and_calibrate(eight_devices):
+    igg.perf.reset()
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    p = sw.Params()
+    sw.make_step(p, donate=False)      # compile registers the family
+    reg = igg.perf.registered_families()
+    assert "shallow_water" in reg and reg["shallow_water"]["accesses"] == 6
+    assert igg.perf.bytes_per_step("shallow_water", "shallow_water.xla",
+                                   (8, 8), np.float32) == 6 * 8 * 8 * 4
+    # chunk tiers are excluded from the per-step traffic model
+    assert igg.perf.bytes_per_step("shallow_water", "shallow_water.chunk",
+                                   (8, 8), np.float32) is None
+    sec = igg.perf.calibrate("shallow_water", nt=2)
+    assert sec > 0
+    assert igg.perf.best("shallow_water") is not None
+
+
+def test_heal_recalibrate_spec_family(eight_devices):
+    """The heal loop's drift action measures a spec-defined family
+    through the registration hook (no re-anchor fallback: the measured
+    seconds come from a fresh calibration dispatch)."""
+    igg.perf.reset()
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    sw.make_step(sw.Params(), donate=False)     # registers the family
+    sec = igg.heal.recalibrate("shallow_water")
+    assert sec is not None and sec > 0
+    best = igg.perf.best("shallow_water")
+    assert best is not None and "heal" in best["sources"]
+
+
+def test_autotune_registration_candidates(eight_devices):
+    igg.autotune.reset()
+    igg.init_global_grid(8, 8, 1, periodx=1, periody=1, quiet=True)
+    p = sw.Params()
+    sw.make_step(p, donate=False)
+    cands = igg.autotune.candidates_for("shallow_water", n_inner=6,
+                                        interpret=True)
+    tiers = {c["tier"] for c in cands}
+    assert {"shallow_water.xla", "shallow_water.mosaic",
+            "shallow_water.chunk"} <= tiers
+    assert any(c["K"] == 4 for c in cands
+               if c["tier"] == "shallow_water.chunk")
+
+
+def test_unknown_family_errors_name_registry(eight_devices):
+    igg.init_global_grid(6, 6, 1, periodx=1, periody=1, quiet=True)
+    with pytest.raises(igg.GridError, match="register_family"):
+        igg.perf.calibrate("no_such_family")
+    with pytest.raises(igg.GridError, match="register_family"):
+        igg.autotune.candidates_for("no_such_family")
