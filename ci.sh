@@ -188,6 +188,26 @@ else
     exit 1
 fi
 
+# Round 18: the live ops plane.  With the statusd endpoint serving and a
+# scraper attached, run_resilient's hot loop pays one health-tracker
+# bus-subscriber callback per emitted record — the HTTP server, the HBM
+# poller, and the multi-rank merge all run on statusd's own threads —
+# the contract is < 1% over the bare watchdog loop at 128^3
+# watch_every=50 with ZERO additional device->host syncs
+# (sentinel-asserted in tests/test_telemetry.py with statusd enabled and
+# a live scraper).  Eighth row of resilience_overhead.py, emitted on
+# every platform and golden-gated like the other seven.
+if grep '"metric": "statusd_overhead"' \
+        benchmarks/results_smoke/resilience_overhead.jsonl \
+        | grep -q '"pass": true'; then
+    echo "    statusd_overhead smoke row PRESENT and within the <1%"
+    echo "    contract (resilience_overhead.jsonl)"
+else
+    echo "    statusd_overhead smoke row MISSING or overhead >= 1%"
+    echo "    (benchmarks/results_smoke/resilience_overhead.jsonl)"
+    exit 1
+fi
+
 # Round 14: the halo-bandwidth byte-accounting golden must BITE — a
 # flipped contract flag against the committed golden has to fail the
 # gate (the goldens comparison in run_all --compare above proves the
@@ -270,6 +290,19 @@ echo "    snapshot + Prometheus file + span trace; ResilienceError ->"
 echo "    flight-recorder auto-dump; python -m igg.telemetry merge) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/observed_run.py
+
+# Round 18: the live ops plane end to end.  A run served by igg.statusd
+# is scraped MID-RUN (/metrics with # HELP lines, /healthz ready,
+# /status progress + serving tier), then a chaos collective stall flips
+# /healthz to 503 naming collective_stall while the loop is wedged,
+# readiness RECOVERS to 200 once the episode drains (same process, no
+# restart), python -m igg.top renders the endpoint, and a clean
+# shutdown releases the port — all asserted inside the example.
+echo "=== live ops plane end to end (serve= -> mid-run scrape -> chaos"
+echo "    stall -> readiness flips 503 -> recovers -> igg.top -> clean"
+echo "    shutdown releases the port; 8-device CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/observed_service.py
 
 echo "=== communication observability end to end (comm ledger calibration"
 echo "    -> per-window step-time decomposition riding run_resilient ->"

@@ -146,13 +146,15 @@ def main(nx=8, nt=80):
     report = json.loads((tdir / "stall_r0.json").read_text())
     assert report["reason"] == "collective_stall"
     assert report["in_flight"] == st["payload"]["in_flight"]
-    dump = json.loads((tdir / "flight_r0.json").read_text())
+    dumps = igg.telemetry.flight_dumps(tdir, rank=0)
+    assert dumps, sorted(p.name for p in tdir.iterdir())
+    dump = json.loads(dumps[0].read_text())
     assert "collective_stall" in dump["reason"], dump["reason"]
     say(f"  collective_stall @ step {st['step']}: "
         f"{st['payload']['in_flight']} not ready after "
         f"{st['payload']['age_s']}s (last completed: "
         f"{st['payload']['last_completed_step']}); stall_r0.json + "
-        f"flight_r0.json present")
+        f"{dumps[0].name} present")
 
     # ---- 4. the report CLI over the artifacts ----
     out = subprocess.run(

@@ -15,8 +15,10 @@ What `igg.telemetry` gives a production run (the same harness
    Chrome-trace span export (`trace_r0.json`) — and the event stream
    contains the watchdog → rollback → tier-demotion story IN ORDER;
 2. an unrecoverable failure (no checkpoint ring to roll back to): the
-   `ResilienceError` auto-dumps the flight recorder (`flight_r0.json`),
-   so the post-mortem has the last N events even though the run died;
+   `ResilienceError` auto-dumps the flight recorder
+   (`flight_r0.<run-id>.json`, found via
+   `igg.telemetry.flight_dumps`), so the post-mortem has the last N
+   events even though the run died;
 3. `python -m igg.telemetry merge` combines the rank-tagged streams into
    one ordered stream (single-rank here; the multihost case is the same
    invocation with more files).
@@ -127,11 +129,12 @@ def main(nx=8, nt=40):
         raise AssertionError("expected ResilienceError")
     except igg.ResilienceError:
         pass
-    flight = tdir / "flight_r0.json"
-    assert flight.is_file(), flight
+    dumps = igg.telemetry.flight_dumps(tdir, rank=0)
+    assert dumps, sorted(p.name for p in tdir.iterdir())
+    flight = dumps[0]
     dump = json.loads(flight.read_text())
     assert any(r["kind"] == "nan_detected" for r in dump["events"])
-    say(f"  flight_r0.json present ({len(dump['events'])} events, reason: "
+    say(f"  {flight.name} present ({len(dump['events'])} events, reason: "
         f"{dump['reason']!r})")
 
     # ---- 3. the merge tool (single-controller invocation) ----

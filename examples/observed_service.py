@@ -1,0 +1,169 @@
+"""The live ops plane end to end: a running simulation served by
+`igg.statusd`, scraped MID-RUN, chaos-stalled, and watched to recovery
+— all asserted from the HTTP surface alone (the `ci.sh` harness).
+
+1. a `run_resilient` with `serve=` on: while the loop runs (wedged at a
+   dispatch boundary by a `chaos_hold` injection so "mid-run" is
+   deterministic), `/metrics` (Prometheus text incl. `# HELP` lines),
+   `/healthz` (ready), and `/status` (run progress, serving tiers) all
+   answer from statusd's own threads;
+2. an injected collective stall (`igg.chaos.collective_stall` + a short
+   `IGG_COMM_STALL_TIMEOUT`): `/healthz` flips to 503 naming
+   `collective_stall` while the run is still going, and RECOVERS to 200
+   once the episode drains at end of run — same process, no restart;
+3. `python -m igg.top <url> --once` renders the endpoint as a dashboard
+   frame;
+4. clean shutdown: `stop()` releases the port (an immediate rebind
+   succeeds).
+
+Run on TPU or on a virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/observed_service.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg import statusd
+from igg.models import diffusion3d as d3
+
+
+def get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main(nx=8, nt=60):
+    igg.init_global_grid(nx, nx, 64, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    me = igg.get_global_grid().me
+
+    def say(msg):
+        if me == 0:
+            print(msg)
+
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+    step = d3.make_step(params, use_pallas=False, donate=False)
+
+    def step_fn(s):
+        return {"T": step(s["T"], Cp)}
+
+    srv = statusd.StatusServer(port=0).start()
+    say(f"observed service: statusd up at {srv.url}")
+
+    # ---- 1. scrape the endpoint MID-RUN ----
+    hold_step = nt // 2
+    plan = igg.chaos.ChaosPlan(hold_at=[(hold_step, 1.0)])
+    result = {}
+
+    def run_healthy():
+        result["res"] = igg.run_resilient(
+            step_fn, {"T": T0 + 0}, nt, watch_every=10, serve=srv,
+            chaos=plan, install_sigterm=False)
+
+    t = threading.Thread(target=run_healthy, daemon=True)
+    t.start()
+    # Wait until the run is visibly in progress on the endpoint.
+    deadline = time.monotonic() + 30
+    mid = None
+    while time.monotonic() < deadline:
+        code, s = get_json(srv.url + "/status")
+        run = (s.get("runs") or {}).get("resilient")
+        if run and not run.get("finished"):
+            mid = s
+            break
+        time.sleep(0.02)
+    assert mid is not None, "run never became visible on /status"
+    with urllib.request.urlopen(srv.url + "/metrics", timeout=5) as r:
+        body = r.read().decode()
+    assert "# HELP igg_steps_total" in body, body.splitlines()[:5]
+    assert "igg_steps_total" in body
+    code, h = get_json(srv.url + "/healthz")
+    assert code == 200 and h["live"] and h["ready"], h
+    say(f"  mid-run: /metrics ({len(body.splitlines())} lines, HELP'd), "
+        f"/healthz ready, /status run at step "
+        f"{mid['runs']['resilient'].get('steps_done')}/{nt}")
+    t.join(timeout=120)
+    assert not t.is_alive() and result["res"].steps_done == nt
+    code, s = get_json(srv.url + "/status")
+    assert s["runs"]["resilient"]["finished"] is True
+    assert s["tiers"].get("diffusion3d"), s["tiers"]
+    say(f"  run finished; serving tier {s['tiers']['diffusion3d']}")
+
+    # ---- 2. stall -> readiness flips -> recovers ----
+    os.environ["IGG_COMM_STALL_TIMEOUT"] = "0.05"
+    plan2 = igg.chaos.ChaosPlan(hold_at=[(hold_step, 1.0)])
+    result2 = {}
+
+    def run_stalled():
+        with igg.chaos.collective_stall():
+            result2["res"] = igg.run_resilient(
+                step_fn, {"T": T0 + 0}, nt, watch_every=10,
+                max_pending_probes=1000, serve=srv, chaos=plan2,
+                install_sigterm=False)
+
+    t2 = threading.Thread(target=run_stalled, daemon=True)
+    t2.start()
+    flipped = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        code, h = get_json(srv.url + "/healthz")
+        if code == 503:
+            flipped = h
+            break
+        time.sleep(0.01)
+    assert flipped is not None, "readiness never flipped during the stall"
+    reasons = [r["reason"] for r in flipped["reasons"]]
+    assert "collective_stall" in reasons, flipped
+    detail = flipped["reasons"][reasons.index("collective_stall")]
+    assert flipped["live"] is True        # liveness: it ANSWERED
+    say(f"  stall: /healthz 503 ready=false "
+        f"(reason=collective_stall, in_flight={detail['in_flight']!r}) "
+        f"while the loop is wedged")
+    t2.join(timeout=120)
+    assert not t2.is_alive() and result2["res"].steps_done == nt
+    code, h = get_json(srv.url + "/healthz")
+    assert code == 200 and h["ready"], h
+    say("  episode drained at end of run: /healthz 200 ready=true again")
+    del os.environ["IGG_COMM_STALL_TIMEOUT"]
+
+    # ---- 3. the dashboard over the live endpoint ----
+    out = subprocess.run(
+        [sys.executable, "-m", "igg.top", srv.url, "--once", "--plain"],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), os.pardir))
+    assert out.returncode == 0, out.stderr
+    assert "igg.top" in out.stdout and "READY" in out.stdout
+    say("  python -m igg.top --once rendered the endpoint:")
+    for line in out.stdout.splitlines()[:6]:
+        say(f"    | {line}")
+
+    # ---- 4. clean shutdown releases the port ----
+    port = srv.port
+    srv.stop()
+    srv2 = statusd.StatusServer(port=port).start()
+    assert srv2.port == port
+    srv2.stop()
+    say(f"  clean shutdown: port {port} released and rebound")
+
+    igg.finalize_global_grid()
+    say("observed_service: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,11 @@ collectives into structured artifacts), and the self-healing control
 plane (`igg.heal` — a policy engine subscribed to the event bus that
 closes the detection→action loops: stall/straggler → elastic re-tile,
 cost-model drift → re-calibration, lagging fleet job → repack, all
-budget/hysteresis-governed and chaos-proven).
+budget/hysteresis-governed and chaos-proven), and the live ops plane
+(`igg.statusd` — an always-on HTTP endpoint serving `/metrics`,
+`/healthz`, `/status`, and `/events` with live HBM gauges and
+multi-rank aggregation, wired via the `serve=` knob on the run loops;
+`python -m igg.top` renders it as a terminal dashboard).
 """
 
 from ._compat import install as _compat_install
@@ -120,6 +124,7 @@ from . import heal
 from . import perf
 from . import profiling
 from . import resilience
+from . import statusd
 from . import stencil
 from . import telemetry
 from . import tools
@@ -148,5 +153,5 @@ __all__ = [
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
     "telemetry", "Telemetry", "perf", "comm", "heal", "autotune",
-    "stencil", "time_steps", "__version__",
+    "statusd", "stencil", "time_steps", "__version__",
 ]

@@ -76,6 +76,20 @@ _KNOWN: Dict[str, str] = {
         "rank-tagged automatically on multi-controller runs)",
     "IGG_PERF_SAVE_EVERY":
         "minimum seconds between perf-ledger autosaves (default 60)",
+    "IGG_STATUSD_PORT":
+        "TCP port of the igg.statusd live ops endpoint (0/unset: off; "
+        "the serve= knob on the run loops overrides)",
+    "IGG_STATUSD_HOST":
+        "bind address of the igg.statusd endpoint (default 127.0.0.1)",
+    "IGG_STATUSD_HBM_EVERY":
+        "minimum seconds between device memory_stats polls behind the "
+        "igg_hbm_* gauges (default 10)",
+    "IGG_STATUSD_MAX_FETCH_LAG":
+        "watchdog fetch-lag (steps) beyond which /healthz readiness "
+        "flips false (default 1000; 0 disables the lag check)",
+    "IGG_STATUSD_PUBLISH_EVERY":
+        "seconds between the non-zero-rank statusd snapshot files that "
+        "rank 0's endpoint merges (default 5)",
     "IGG_TELEMETRY_DEVICE":
         "0 disables mirroring trace spans onto the device timeline "
         "(jax.profiler.TraceAnnotation)",

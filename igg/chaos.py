@@ -105,13 +105,23 @@ class ChaosPlan:
     within the member's stacked field), which is what proves the per-member
     isolation paths of :mod:`igg.ensemble`.
     `preempt_at`: simulate a preemption signal when the loop reaches that
-    step.  Each injection fires ONCE (a transient fault): after rollback the
+    step.
+    `hold_at`: iterable of `(step, seconds)` — WEDGE the main loop on the
+    caller's thread for that long at the dispatch boundary (a
+    `time.sleep`, `chaos_hold` event).  This is the deterministic
+    stand-in for a run loop stuck between dispatches (a hung host, a
+    blocked fetch): everything that lives on its own thread — the stall
+    heartbeat, the `igg.statusd` endpoint — must keep speaking while the
+    loop is held, which is exactly what the statusd liveness chaos proof
+    asserts.
+    Each injection fires ONCE (a transient fault): after rollback the
     replay passes the same step clean, which is exactly what makes
     recovery-without-policy provable.  `reset()` re-arms everything.
     """
 
     def __init__(self, nan_at: Sequence = (),
-                 preempt_at: Optional[int] = None):
+                 preempt_at: Optional[int] = None,
+                 hold_at: Sequence = ()):
         entries = []
         for e in nan_at:
             if len(e) >= 2 and isinstance(e[1], (int, np.integer)):
@@ -130,6 +140,14 @@ class ChaosPlan:
                                 else None))
         self.nan_at: Tuple = tuple(entries)
         self.preempt_at = preempt_at
+        holds = []
+        for h in hold_at:
+            if len(h) != 2 or float(h[1]) < 0:
+                raise GridError(
+                    f"ChaosPlan: hold_at entry {h!r} must be "
+                    f"(step, seconds >= 0).")
+            holds.append((int(h[0]), float(h[1])))
+        self.hold_at: Tuple = tuple(holds)
         self._fired = set()
 
     def reset(self) -> None:
@@ -157,6 +175,14 @@ class ChaosPlan:
                 if member is not None:
                     detail["member"] = member
                 emit("chaos_nan", step, **detail)
+        for k, seconds in self.hold_at:
+            key = ("hold", k)
+            if step <= k < step + span and key not in self._fired:
+                self._fired.add(key)
+                emit("chaos_hold", step, seconds=seconds)
+                import time
+
+                time.sleep(seconds)
         if (self.preempt_at is not None
                 and step <= self.preempt_at < step + span
                 and ("preempt", self.preempt_at) not in self._fired):

@@ -95,6 +95,7 @@ import os
 import pathlib
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -107,7 +108,7 @@ from .shared import GridError
 __all__ = [
     "plane_bytes_model", "link_peak_gbps", "record_exchange",
     "calibrate_comm", "decompose", "StepDecomposition", "StallWatchdog",
-    "make_stall_watchdog", "rank_skew",
+    "make_stall_watchdog", "active_stalls", "rank_skew",
 ]
 
 
@@ -559,6 +560,42 @@ class StepDecomposition:
 # Collective-stall detection
 # ---------------------------------------------------------------------------
 
+# Live watchdog registry (igg.statusd's readiness source): every
+# StallWatchdog registers itself at construction and deregisters on
+# close(); a WeakSet so an abandoned, never-closed instance cannot pin
+# a stale "stalled" verdict forever.
+_live_lock = threading.Lock()
+_LIVE_WATCHDOGS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _register_watchdog(w: "StallWatchdog") -> None:
+    with _live_lock:
+        _LIVE_WATCHDOGS.add(w)
+
+
+def _unregister_watchdog(w: "StallWatchdog") -> None:
+    with _live_lock:
+        _LIVE_WATCHDOGS.discard(w)
+
+
+def active_stalls() -> List[dict]:
+    """The stall episodes currently IN PROGRESS across every live
+    :class:`StallWatchdog` (fired and not yet drained) — each entry the
+    heartbeat's ``collective_stall`` payload plus the step and wall time
+    it fired at.  Empty when every channel is healthy; an episode leaves
+    this list the moment its in-flight channel fully drains (the
+    re-arm), which is what lets `igg.statusd`'s `/healthz` readiness
+    RECOVER without a restart."""
+    with _live_lock:
+        dogs = list(_LIVE_WATCHDOGS)
+    out = []
+    for w in dogs:
+        with w._lock:
+            if w._stalled and w.stall_info is not None:
+                out.append(dict(w.stall_info))
+    return out
+
+
 class StallWatchdog:
     """Host-side heartbeat thread that turns a hung collective into an
     actionable artifact (module docstring).  `watch(key, step, what,
@@ -593,6 +630,11 @@ class StallWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stalls = 0
+        # The live-readiness surface (igg.statusd): the payload of the
+        # episode currently in progress, None once the channel drains and
+        # the episode re-arms.
+        self.stall_info: Optional[dict] = None
+        _register_watchdog(self)
 
     def watch(self, key, step: int, what: str, obj=None) -> None:
         with self._lock:
@@ -616,6 +658,7 @@ class StallWatchdog:
             # mid-drain and double-report one stall.
             if not self._inflight:
                 self._stalled = False
+                self.stall_info = None
 
     def clear(self) -> None:
         """Forget every in-flight entry (the run loop's `pending.clear()`
@@ -623,6 +666,14 @@ class StallWatchdog:
         with self._lock:
             self._inflight.clear()
             self._stalled = False
+            self.stall_info = None
+
+    @property
+    def stalled(self) -> bool:
+        """Whether a stall episode is currently in progress (fired and
+        not yet drained) — the live readiness signal `igg.statusd`
+        derives `/healthz` from."""
+        return self._stalled
 
     def close(self) -> None:
         self._stop.set()
@@ -630,6 +681,7 @@ class StallWatchdog:
         if t is not None:
             t.join(timeout=5.0)
         self._thread = None
+        _unregister_watchdog(self)
 
     # -- the heartbeat -----------------------------------------------------
     def _loop(self) -> None:
@@ -662,13 +714,14 @@ class StallWatchdog:
         return True
 
     def _fire(self, step, what, age, pending, last_completed) -> None:
-        with self._lock:
-            self._stalled = True
-            self.stalls += 1
         payload = {"run": self.run, "in_flight": what,
                    "age_s": round(age, 3), "timeout_s": self.timeout_s,
                    "last_completed_step": last_completed,
                    "pending": pending}
+        with self._lock:
+            self._stalled = True
+            self.stalls += 1
+            self.stall_info = {"step": step, "wall": time.time(), **payload}
         _telemetry.emit("collective_stall", step=step, **payload)
         self._write_reports({"reason": "collective_stall", "step": step,
                              "wall": time.time(),

@@ -142,6 +142,49 @@ def _select(me_l: int, n_procs_on_host: int, n_local: int,
     return me_l % n_local
 
 
+def memory_stats(devices=None) -> List[dict]:
+    """Per-device allocator statistics from the runtime, for the live
+    HBM gauges of :mod:`igg.statusd`.
+
+    Queries ``Device.memory_stats()`` on each of `devices` (default:
+    this process's ``jax.local_devices()``) — a host-side allocator
+    lookup, no device synchronization — and returns one entry per
+    device that actually reports them::
+
+        {"device": "tpu:0", "kind": "TPU v5p", "bytes_in_use": ...,
+         "bytes_limit": ..., "peak_bytes_in_use": ...}
+
+    Backends without allocator stats (the CPU backend among them) are
+    HONESTLY OMITTED — an empty list, never an invented number (the
+    `link_peak=None` precedent of :func:`igg.comm.link_peak_gbps`).
+    Byte fields present in the runtime's dict but absent here simply
+    were not reported."""
+    import jax
+
+    if devices is None:
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return []
+    out: List[dict] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        entry = {"device": f"{d.platform}:{d.id}",
+                 "kind": getattr(d, "device_kind", d.platform)}
+        for k in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use",
+                  "bytes_reserved", "largest_free_block_bytes"):
+            v = stats.get(k)
+            if v is not None:
+                entry[k] = int(v)
+        out.append(entry)
+    return out
+
+
 def select_device() -> int:
     """Bind this process to its node-local device and return the device id.
 

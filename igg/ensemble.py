@@ -473,6 +473,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                  install_sigterm: bool = True,
                  on_event: Optional[Callable[[Event], None]] = None,
                  telemetry=None,
+                 serve=None,
                  chaos=None) -> EnsembleResult:
     """Drive M independent members of `step_fn` for `n_steps` steps in ONE
     compiled program with per-member fault isolation (module docstring for
@@ -511,6 +512,11 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
       aggregate member rates (piggybacked on the per-member watchdog's
       async fetches — zero extra host syncs), exports metrics, and
       auto-dumps the flight recorder on faults.
+    - `serve`: the live ops endpoint (:mod:`igg.statusd` — the
+      :func:`igg.run_resilient` contract: None = ``IGG_STATUSD_PORT``-
+      driven, int port, True, shared server, or False).  `/healthz`
+      readiness flips false when EVERY member is quarantined — the
+      batch has nothing left to serve.
     - `chaos`: an :class:`igg.chaos.ChaosPlan`; member-targeted entries
       `(step, member, field)` poison one member's lane.
 
@@ -618,6 +624,19 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     tel_owns = tel is not None and not tel.attached
     if tel_owns:
         tel.attach()
+    # Live ops endpoint (igg.statusd): the run_resilient contract.
+    from . import statusd as _statusd
+
+    try:
+        srv = _statusd.as_server(serve)
+        srv_owns = srv is not None and not srv.started
+        if srv_owns:
+            srv.start()
+    except BaseException:
+        # A bind failure must not leak the run-owned session.
+        if tel_owns:
+            tel.detach()
+        raise
     _telemetry.emit("run_started", run="ensemble", n_steps=n_steps,
                     members=members, packing=pk.name,
                     watch_every=watch_every, steps_per_call=steps_per_call)
@@ -706,6 +725,8 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                   if (watch and watch_every) else None)
     except BaseException as e:
         _telemetry._auto_dump(f"run_ensemble: {type(e).__name__}: {e}")
+        if srv_owns:
+            srv.stop()
         if tel_owns:
             tel.detach()
         raise
@@ -1055,6 +1076,8 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                         preempted=preempted,
                         quarantined=sorted(int(m)
                                            for m in np.nonzero(~valid)[0]))
+        if srv_owns:
+            srv.stop()
         if tel is not None:
             # Owned sessions export inside detach(); exporting here too
             # would write two identical back-to-back snapshots.

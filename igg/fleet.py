@@ -280,7 +280,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
               backoff: Optional[float] = None,
               install_sigterm: bool = True,
               on_event: Optional[Callable[[Event], None]] = None,
-              telemetry=None, heal=None) -> FleetResult:
+              telemetry=None, serve=None, heal=None) -> FleetResult:
     """Drain `jobs` in order onto the live devices (module docstring for
     the full contract).  The caller must NOT hold an initialized grid —
     the scheduler owns grid lifecycle per job.  `resume=True` reconciles
@@ -293,6 +293,12 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
     the WHOLE drain: job lifecycle spans, a fleet queue-depth gauge,
     per-status job counters, and every job-scoped event on one
     rank-tagged JSONL stream.
+
+    `serve` attaches the live ops endpoint (:mod:`igg.statusd` — the
+    :func:`igg.run_resilient` contract: None = ``IGG_STATUSD_PORT``-
+    driven, int port, True, shared server, or False) for the WHOLE
+    drain; its `/status` additionally summarizes this drain's queue
+    journal (per-status job counts).
 
     `heal` attaches the self-healing control plane (:mod:`igg.heal` —
     the :func:`igg.run_resilient` coercion: None = ``IGG_HEAL``-driven,
@@ -358,6 +364,24 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
     from . import heal as _heal
 
     heal_eng = _heal.as_engine(heal, run="fleet")
+    # Live ops endpoint (igg.statusd) for the whole drain; /status reads
+    # this drain's journal for the per-status job counts.  Started AFTER
+    # the heal= coercion above (a GridError there must not leak a bound
+    # server), and a bind failure must not leak the drain-owned session
+    # (the health tracker backfills run_started from the flight ring).
+    from . import statusd as _statusd
+
+    try:
+        srv = _statusd.as_server(serve)
+        srv_owns = srv is not None and not srv.started
+        if srv_owns:
+            srv.start()
+    except BaseException:
+        if tel_owns:
+            tel.detach()
+        raise
+    if srv is not None:
+        srv.watch_fleet(jpath)
     if heal_eng is not None:
         heal_eng.attach()
     m_queue = _telemetry.gauge("igg_fleet_queue_depth")
@@ -448,6 +472,8 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
             clear_preemption()
         _telemetry.emit("run_finished", run="fleet",
                         preempted=fleet_preempted)
+        if srv_owns:
+            srv.stop()
         if tel is not None:
             # Owned sessions export inside detach(); exporting here too
             # would write two identical back-to-back snapshots.
@@ -579,6 +605,10 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
                         packing=packing, devices=launch_devs,
                         install_sigterm=False, on_event=job_event,
                         telemetry=tel if tel is not None else False,
+                        # serve=False: the drain's endpoint covers every
+                        # job — an env-driven nested server would try to
+                        # bind the port the fleet's own server holds.
+                        serve=False,
                         chaos=chaos)
                 finally:
                     if slowdown is not None:

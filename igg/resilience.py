@@ -422,6 +422,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   install_sigterm: bool = True,
                   on_event: Optional[Callable[[Event], None]] = None,
                   telemetry=None,
+                  serve=None,
                   comm=None,
                   heal=None,
                   chaos=None) -> RunResult:
@@ -475,6 +476,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
       piggybacked on the watchdog's async fetches (zero extra host
       syncs), exports metrics snapshots, and auto-dumps the flight
       recorder on `ResilienceError`/preemption/unhandled escapes.
+    - `serve`: the live ops endpoint (:mod:`igg.statusd`) — None
+      (default: on only when ``IGG_STATUSD_PORT`` is set non-zero), an
+      int TCP port (0 = ephemeral), True (env port, else ephemeral), a
+      shared :class:`igg.statusd.StatusServer`, or False (off).  The
+      endpoint serves `/metrics`, `/healthz`, `/status`, and `/events`
+      from its own threads for the run's duration (an already-started
+      shared server is left running); readiness flips false on an
+      active collective-stall episode, all-members-quarantined, a heal
+      escalation, or excessive watchdog fetch lag
+      (docs/observability.md, "Live endpoint").
     - `comm`: an :class:`igg.comm.StepDecomposition` monitor — per-window
       step-time decomposition probes (compute-only / compute+exchange /
       hidden-overlap) dispatched at the watch cadence and observed through
@@ -646,6 +657,25 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         else:
             comm_mon = comm
 
+    # Live ops endpoint (igg.statusd), started AFTER the heal=/comm=
+    # argument validations above: a GridError there must not leak a
+    # bound HTTP server (nor may a bind failure — a real runtime
+    # condition when the port is taken — leak the attached session).
+    # The endpoint still covers the whole run: the health tracker
+    # backfills run_started from the flight ring on attach, and the
+    # pre-loop except + the main finally both stop an owned server.
+    from . import statusd as _statusd
+
+    try:
+        srv = _statusd.as_server(serve)
+        srv_owns = srv is not None and not srv.started
+        if srv_owns:
+            srv.start()
+    except BaseException:
+        if tel_owns:
+            tel.detach()
+        raise
+
     # Subscribe AFTER the argument validations above: a GridError there
     # must not leak the engine into the process-global subscriber list
     # (the pre-loop except and the main finally both detach).
@@ -688,6 +718,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
         if heal_eng is not None:
             heal_eng.detach()
+        if srv_owns:
+            srv.stop()
         if tel_owns:
             tel.detach()
         raise
@@ -1277,6 +1309,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         clear_preemption()
         _telemetry.emit("run_finished", step=steps_done, run="resilient",
                         preempted=preempted, retries=retries)
+        if srv_owns:
+            srv.stop()
         if tel is not None:
             # Owned sessions get their final export inside detach();
             # exporting here too would write two identical back-to-back

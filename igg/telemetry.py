@@ -94,9 +94,10 @@ from .shared import GridError
 
 __all__ = [
     "Record", "Telemetry", "emit", "span", "counter", "gauge", "histogram",
-    "snapshot", "prometheus_text", "reset_metrics", "flight_recorder",
-    "dump_flight_recorder", "export_chrome_trace", "as_session",
-    "merge_streams", "subscribe", "unsubscribe",
+    "snapshot", "metric_samples", "prometheus_text", "reset_metrics",
+    "flight_recorder", "dump_flight_recorder", "flight_dumps", "run_id",
+    "export_chrome_trace", "as_session", "merge_streams", "subscribe",
+    "unsubscribe",
 ]
 
 
@@ -129,6 +130,14 @@ _RING: Optional[deque] = None        # created lazily (size is an env knob)
 _SESSIONS: List["Telemetry"] = []    # attached sinks
 _SUBSCRIBERS: List = []              # live bus consumers (igg.heal engines)
 _process_cached: Optional[int] = None
+# Per-run dump identity (round 18): two runs sharing one telemetry
+# directory used to both write `flight_r<rank>.json`, the second
+# clobbering the first.  Dumps are now suffixed `flight_r<rank>.<id>.json`
+# where the id is a process-unique token plus a run sequence number
+# (rotated on every `run_started` record), so each run's post-mortem
+# survives; :func:`flight_dumps` globs BOTH filename forms.
+_RUN_BASE = f"{os.getpid():x}{int(time.time()) & 0xFFFF:04x}"
+_RUN_SEQ = 0
 
 
 def _env():
@@ -165,10 +174,24 @@ def _ring() -> deque:
     return _RING
 
 
+def run_id() -> str:
+    """The current flight-dump identity: a process-unique token plus the
+    run sequence number (rotated on every ``run_started`` record), the
+    suffix of `flight_r<rank>.<id>.json` dumps."""
+    return f"{_RUN_BASE}-{_RUN_SEQ}"
+
+
 def emit(kind: str, step: Optional[int] = None, **payload) -> Record:
     """Stamp and publish one record: append it to the flight-recorder ring
     (always — a deque append) and hand it to every attached session sink.
     Pure host bookkeeping: no device work, no synchronization."""
+    if kind == "run_started":
+        # Rotate the flight-dump identity: each run's dumps land in their
+        # own `flight_r<rank>.<id>.json` (a second run sharing the
+        # telemetry dir must never clobber the first run's post-mortem).
+        global _RUN_SEQ
+        with _lock:
+            _RUN_SEQ += 1
     rec = Record(t=time.monotonic(), wall=time.time(), process=_process(),
                  kind=kind, step=None if step is None else int(step),
                  payload=payload)
@@ -224,16 +247,45 @@ def flight_recorder() -> List[Record]:
         return list(ring)
 
 
+def _flight_name() -> str:
+    """Rank- and run-tagged dump filename: repeated dumps within one run
+    overwrite (latest wins — the ring carries the full tail anyway), but
+    two runs sharing a telemetry directory never clobber each other."""
+    return f"flight_r{_process()}.{run_id()}.json"
+
+
+def flight_dumps(directory, rank: Optional[int] = None) -> List[pathlib.Path]:
+    """Every flight-recorder dump under `directory`, newest first — BOTH
+    filename forms: the pre-round-18 `flight_r<rank>.json` and the
+    run-id-suffixed `flight_r<rank>.<id>.json` (the merge tool and any
+    post-mortem reader should glob through here rather than hard-coding
+    a name)."""
+    d = pathlib.Path(directory)
+    try:
+        if rank is None:
+            found = list(d.glob("flight_r*.json"))
+        else:
+            # Two exact-rank patterns, NOT a prefix glob: on a pod,
+            # `flight_r1*` would also swallow ranks 10-19's dumps.
+            found = list(d.glob(f"flight_r{rank}.*.json"))
+            legacy = d / f"flight_r{rank}.json"
+            if legacy.exists():
+                found.append(legacy)
+    except OSError:
+        return []
+    return sorted(found, key=lambda p: p.stat().st_mtime, reverse=True)
+
+
 def dump_flight_recorder(reason: str = "requested",
                          path=None) -> List[pathlib.Path]:
     """Dump the ring as JSON: to every attached session's
-    `flight_r<rank>.json`, to `path` when given, and — with neither — to
-    `IGG_TELEMETRY_DIR` when set.  Returns the paths written (empty when
-    there is nowhere to write — the ring itself always remains readable
-    via :func:`flight_recorder`)."""
+    `flight_r<rank>.<run-id>.json`, to `path` when given, and — with
+    neither — to `IGG_TELEMETRY_DIR` when set.  Returns the paths written
+    (empty when there is nowhere to write — the ring itself always remains
+    readable via :func:`flight_recorder`)."""
     recs = [r.as_dict() for r in flight_recorder()]
     doc = {"reason": reason, "wall": time.time(),
-           "process": _process(), "events": recs}
+           "process": _process(), "run_id": run_id(), "events": recs}
     out: List[pathlib.Path] = []
     targets: List[pathlib.Path] = []
     if path is not None:
@@ -241,12 +293,11 @@ def dump_flight_recorder(reason: str = "requested",
     with _lock:
         sessions = list(_SESSIONS)
     for s in sessions:
-        targets.append(s.dir / f"flight_r{_process()}.json")
+        targets.append(s.dir / _flight_name())
     if not targets:
         envdir = _env().text("IGG_TELEMETRY_DIR")
         if envdir:
-            targets.append(pathlib.Path(envdir)
-                           / f"flight_r{_process()}.json")
+            targets.append(pathlib.Path(envdir) / _flight_name())
     for t in targets:
         try:
             t.parent.mkdir(parents=True, exist_ok=True)
@@ -281,6 +332,72 @@ _METRICS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "_Metric"] = {}
 # too (a counter `x{a="1"}` next to a gauge `x{b="2"}` would render an
 # unparsable exposition — one `# TYPE x` line cannot cover both).
 _KIND_BY_NAME: Dict[str, type] = {}
+# Name-level help strings (`# HELP` lines in the exposition).  The
+# registration functions take an optional `help=`; the stack's built-in
+# metrics get theirs from this table so every call site stays a
+# one-liner (a name registered with an explicit help= overrides it).
+_HELP_BY_NAME: Dict[str, str] = {}
+_BUILTIN_HELP: Dict[str, str] = {
+    "igg_steps_total": "Steps completed by a run loop.",
+    "igg_member_steps_total": "Member-steps completed by run_ensemble "
+                              "(steps times active members).",
+    "igg_rollbacks_total": "Checkpoint rollbacks taken by a run loop.",
+    "igg_steps_per_s": "Live step rate of the last watchdog window.",
+    "igg_member_steps_per_s": "Live aggregate member-step rate of the "
+                              "last ensemble watchdog window.",
+    "igg_watchdog_fetch_lag_steps": "Steps between the last fetched "
+                                    "watchdog probe and the loop's "
+                                    "current step.",
+    "igg_rank_window_ms": "This rank's last watchdog-window ms/step "
+                          "(the live straggler signal).",
+    "igg_rank_skew_ms": "Worst-vs-median window time across ranks "
+                        "(igg.comm.rank_skew).",
+    "igg_checkpoint_bytes_total": "Bytes written into checkpoint "
+                                  "generations.",
+    "igg_checkpoint_write_seconds": "Checkpoint generation write "
+                                    "latency.",
+    "igg_halo_plane_bytes_total": "Halo plane bytes moved by "
+                                  "update_halo (per dim and wire/local "
+                                  "mode when labelled).",
+    "igg_halo_gbps": "Measured halo-exchange bandwidth over the logical "
+                     "halo bytes.",
+    "igg_pct_link_peak": "Measured wire-crossing halo bandwidth as a "
+                         "percentage of the chip's published ICI peak.",
+    "igg_achieved_gbps": "Achieved HBM bandwidth of the serving kernel "
+                         "tier (igg.perf).",
+    "igg_pct_hbm_peak": "Achieved HBM bandwidth as a percentage of the "
+                        "chip's published peak (igg.perf).",
+    "igg_cost_model_rel_error": "Relative error of the registered "
+                                "cost-model prediction vs measured "
+                                "step time.",
+    "igg_exposed_comm_fraction": "Exposed communication fraction "
+                                 "(exchange - compute) / exchange of "
+                                 "the last decomposition window.",
+    "igg_overlap_efficiency": "Overlap efficiency (exchange - hidden) /"
+                              " (exchange - compute) of the last "
+                              "decomposition window.",
+    "igg_hide_communication_traces_total": "hide_communication overlap "
+                                           "schedules traced.",
+    "igg_tier_dispatch_total": "Dispatches served per (family, tier) by "
+                               "the degradation ladder.",
+    "igg_tier_quarantined_total": "Kernel tiers quarantined by the "
+                                  "degradation ladder.",
+    "igg_member_quarantined_total": "Ensemble members quarantined after "
+                                    "retry-budget exhaustion.",
+    "igg_fleet_queue_depth": "Fleet jobs not yet terminal this drain.",
+    "igg_fleet_jobs_total": "Fleet jobs finished, by outcome status.",
+    "igg_hbm_bytes_in_use": "Device memory currently allocated "
+                            "(device.memory_stats; absent when the "
+                            "backend exposes no allocator stats).",
+    "igg_hbm_bytes_limit": "Device memory capacity visible to the "
+                           "allocator (absent when the backend exposes "
+                           "no allocator stats).",
+    "igg_hbm_watermark_bytes": "Peak device memory allocated since "
+                               "process start (absent when the backend "
+                               "exposes no allocator stats).",
+    "igg_statusd_requests_total": "HTTP requests served by igg.statusd, "
+                                  "by route.",
+}
 
 
 class _Metric:
@@ -369,9 +486,12 @@ class Histogram(_Metric):
                 "min": self.min, "max": self.max}
 
 
-def _get_metric(cls, name: str, labels: dict) -> _Metric:
+def _get_metric(cls, name: str, labels: dict, help: Optional[str]) -> _Metric:
     lab = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
     key = (name, lab)
+    if help is not None:
+        with _lock:
+            _HELP_BY_NAME[name] = str(help)
     m = _METRICS.get(key)
     if m is None:
         with _lock:
@@ -391,17 +511,26 @@ def _get_metric(cls, name: str, labels: dict) -> _Metric:
     return m
 
 
-def counter(name: str, **labels) -> Counter:
-    """Get-or-create the named counter (optional labels)."""
-    return _get_metric(Counter, name, labels)
+def metric_help(name: str) -> Optional[str]:
+    """The registered `# HELP` string for a metric name (explicit
+    `help=` registration first, the built-in table second, None when
+    neither knows the name)."""
+    return _HELP_BY_NAME.get(name, _BUILTIN_HELP.get(name))
 
 
-def gauge(name: str, **labels) -> Gauge:
-    return _get_metric(Gauge, name, labels)
+def counter(name: str, help: Optional[str] = None, **labels) -> Counter:
+    """Get-or-create the named counter (optional labels; `help` becomes
+    the exposition's `# HELP` line — built-in igg_* names carry one
+    already)."""
+    return _get_metric(Counter, name, labels, help)
 
 
-def histogram(name: str, **labels) -> Histogram:
-    return _get_metric(Histogram, name, labels)
+def gauge(name: str, help: Optional[str] = None, **labels) -> Gauge:
+    return _get_metric(Gauge, name, labels, help)
+
+
+def histogram(name: str, help: Optional[str] = None, **labels) -> Histogram:
+    return _get_metric(Histogram, name, labels, help)
 
 
 def snapshot() -> Dict[str, dict]:
@@ -419,6 +548,24 @@ def reset_metrics() -> None:
     with _lock:
         _METRICS.clear()
         _KIND_BY_NAME.clear()
+        _HELP_BY_NAME.clear()
+
+
+def metric_samples() -> List[dict]:
+    """The registry as structured samples: one
+    ``{name, labels, type, help, ...values}`` dict per metric instance
+    (counters/gauges carry ``value``; histograms ``count/sum/min/max``).
+    This is :func:`snapshot` with the labels kept structured instead of
+    folded into the exposition key — what the `igg.statusd` multi-rank
+    aggregation publishes and merges (a rank label can then be injected
+    without re-parsing exposition keys)."""
+    with _lock:
+        metrics = list(_METRICS.values())
+    out = []
+    for m in metrics:
+        out.append({"name": m.name, "labels": dict(m.labels),
+                    "help": metric_help(m.name), **m.as_dict()})
+    return out
 
 
 def _prom_name(name: str) -> str:
@@ -435,9 +582,17 @@ def _prom_label_value(v: str) -> str:
             .replace("\n", r"\n"))
 
 
+def _prom_help_value(v: str) -> str:
+    """Escape a `# HELP` text per the exposition spec (backslash and
+    newline only — HELP text is not quoted)."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def prometheus_text() -> str:
     """The registry in the Prometheus text exposition format (histograms
-    render as summaries: `_count`/`_sum`, plus `_min`/`_max` gauges)."""
+    render as summaries: `_count`/`_sum`, plus `_min`/`_max` gauges).
+    Metric names with a registered help string (`help=` at registration,
+    or the built-in table) get a `# HELP` line ahead of their `# TYPE`."""
     with _lock:
         metrics = list(_METRICS.values())
     by_name: Dict[str, List[_Metric]] = {}
@@ -450,6 +605,9 @@ def prometheus_text() -> str:
         kind = group[0].kind
         ptype = {"counter": "counter", "gauge": "gauge",
                  "histogram": "summary"}[kind]
+        help_text = metric_help(name)
+        if help_text:
+            out.write(f"# HELP {pname} {_prom_help_value(help_text)}\n")
         out.write(f"# TYPE {pname} {ptype}\n")
         for m in sorted(group, key=lambda g: g.labels):
             lab = ("{" + ",".join(
@@ -607,7 +765,7 @@ class Telemetry:
 
     @property
     def flight_path(self) -> pathlib.Path:
-        return self.dir / f"flight_r{_process()}.json"
+        return self.dir / _flight_name()
 
     # -- lifecycle ---------------------------------------------------------
     def attach(self) -> "Telemetry":
@@ -810,7 +968,9 @@ class StepStats:
 def merge_streams(inputs: Sequence, output=None) -> List[dict]:
     """Merge rank-tagged event JSONL files into one stream ordered by wall
     time (ties broken by process then monotonic t).  `inputs` are files or
-    directories (directories contribute their `events_r*.jsonl`);
+    directories (directories contribute their `events_r*.jsonl`; a
+    flight-recorder dump passed explicitly — either filename form, see
+    :func:`flight_dumps` — contributes its `events` array);
     `output` is a path ('-' or None returns the records without
     writing).  Unparsable lines are skipped with a count in the trailing
     summary record rather than aborting the merge — a post-mortem must
@@ -836,6 +996,22 @@ def merge_streams(inputs: Sequence, output=None) -> List[dict]:
             text = f.read_text()
         except OSError as e:
             raise GridError(f"telemetry merge: cannot read {f}: {e}")
+        if f.suffix == ".json":
+            # A flight-recorder dump handed in explicitly (either
+            # filename form — `flight_r<rank>.json` or the run-id'd
+            # `flight_r<rank>.<id>.json`; :func:`flight_dumps` globs
+            # them): its `events` array merges like any rank stream.
+            try:
+                doc = json.loads(text)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+                records.extend(r for r in doc["events"]
+                               if isinstance(r, dict))
+            else:
+                skipped += 1
+            continue
         for line in text.splitlines():
             line = line.strip()
             if not line:

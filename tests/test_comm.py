@@ -279,7 +279,9 @@ def test_stall_watchdog_fires_deterministically_via_chaos(tmp_path,
     report = json.loads((tmp_path / "stall_r0.json").read_text())
     assert report["reason"] == "collective_stall"
     assert report["step"] == stalls[0]["step"]
-    dump = json.loads((tmp_path / "flight_r0.json").read_text())
+    dumps = tel.flight_dumps(tmp_path, rank=0)
+    assert dumps, list(tmp_path.iterdir())
+    dump = json.loads(dumps[0].read_text())
     assert "collective_stall" in dump["reason"]
     assert any(r["kind"] == "collective_stall" for r in dump["events"])
 

@@ -1,0 +1,710 @@
+"""The live ops plane (igg/statusd.py) and its round-18 satellites: the
+HTTP endpoint routes, readiness semantics (machine-readable reasons,
+pinned), the chaos liveness proof (a wedged main loop cannot silence the
+endpoint), HBM-gauge honesty, multi-rank snapshot aggregation, the
+`# HELP` exposition satellite, run-id'd flight dumps, and the `igg.top`
+renderer over both sources."""
+
+import json
+import pathlib
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import igg
+from igg import comm as icomm
+from igg import statusd
+from igg import telemetry as tel
+from igg import top as itop
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Metrics, the ring, and sessions are process-global; isolate every
+    test (the test_telemetry fixture)."""
+    tel.reset_metrics()
+    tel._ring().clear()
+    yield
+    for s in list(tel._SESSIONS):
+        s.detach()
+    tel.reset_metrics()
+
+
+def _grid(**kw):
+    args = dict(periodx=1, periody=1, periodz=1, quiet=True)
+    args.update(kw)
+    igg.init_global_grid(6, 6, 6, **args)
+
+
+def _make_step():
+    from igg.ops import interior_add
+
+    @igg.sharded
+    def step(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(interior_add(T, 0.1 * lap))
+
+    return lambda st: {"T": step(st["T"])}
+
+
+def _init_state(seed=3):
+    rng = np.random.default_rng(seed)
+    T = igg.from_local_blocks(lambda c, ls: rng.standard_normal(ls),
+                              (6, 6, 6))
+    return {"T": igg.update_halo(T)}
+
+
+def _get(url):
+    """(HTTP code, parsed JSON body) — 503 included (urllib raises on
+    it, which IS the readiness signal under test)."""
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_text(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# (i) coercion and lifecycle
+# ---------------------------------------------------------------------------
+
+def test_as_server_coercion(monkeypatch):
+    monkeypatch.delenv("IGG_STATUSD_PORT", raising=False)
+    assert statusd.as_server(False) is None
+    assert statusd.as_server(None) is None            # env unset -> off
+    monkeypatch.setenv("IGG_STATUSD_PORT", "0")
+    assert statusd.as_server(None) is None            # env 0 -> off
+    monkeypatch.setenv("IGG_STATUSD_PORT", "9137")
+    srv = statusd.as_server(None)
+    assert isinstance(srv, statusd.StatusServer)
+    assert srv.requested_port == 9137 and not srv.started
+    srv2 = statusd.as_server(True)
+    assert srv2.requested_port == 9137
+    srv3 = statusd.as_server(4242)
+    assert srv3.requested_port == 4242
+    shared = statusd.StatusServer(port=0)
+    assert statusd.as_server(shared) is shared
+    with pytest.raises(igg.GridError, match="serve="):
+        statusd.as_server("nope")
+
+
+def test_start_stop_releases_port():
+    srv = statusd.StatusServer(port=0).start()
+    port = srv.port
+    assert port and srv.url.endswith(str(port))
+    srv.stop()
+    # The port is released: an immediate rebind succeeds.
+    srv2 = statusd.StatusServer(port=port).start()
+    assert srv2.port == port
+    srv2.stop()
+    srv2.stop()   # idempotent
+
+
+# ---------------------------------------------------------------------------
+# (ii) the routes
+# ---------------------------------------------------------------------------
+
+def test_routes_metrics_healthz_status_events():
+    tel.counter("igg_steps_total", run="t").inc(7)
+    tel.emit("run_started", run="resilient", n_steps=40)
+    tel.emit("step_stats", step=20, run="resilient", steps_per_s=5.0,
+             ms_per_step=200.0, window_steps=20, fetch_lag_steps=1)
+    tel.emit("checkpoint", step=20, path="/tmp/ck_000000020")
+    with statusd.StatusServer(port=0) as srv:
+        code, body = _get_text(srv.url + "/metrics")
+        assert code == 200
+        assert 'igg_steps_total{run="t"} 7.0' in body
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["live"] and h["ready"]
+        assert h["reasons"] == []
+        code, s = _get(srv.url + "/status")
+        assert code == 200
+        run = s["runs"]["resilient"]
+        assert run["n_steps"] == 40 and run["steps_done"] == 20
+        assert run["steps_per_s"] == 5.0
+        assert s["checkpoint"]["step"] == 20
+        assert s["health"]["ready"] is True
+        assert isinstance(s["tiers"], dict)
+        # /events tails the ring as JSONL, ?n= bounded.
+        code, nd = _get_text(srv.url + "/events?n=2")
+        assert code == 200
+        lines = [json.loads(ln) for ln in nd.splitlines()]
+        assert len(lines) == 2
+        assert all("kind" in r for r in lines)
+        code, e = _get(srv.url + "/nope")
+        assert code == 404 and "/metrics" in e["routes"]
+
+
+# ---------------------------------------------------------------------------
+# (iii) readiness semantics (reason strings PINNED — treat as API)
+# ---------------------------------------------------------------------------
+
+def test_readiness_stall_episode_and_rearm():
+    """An active collective-stall episode flips readiness false with
+    reason 'collective_stall'; the episode draining re-arms readiness
+    without a restart."""
+    w = icomm.StallWatchdog(0.01, run="resilient", poll_s=100)
+    try:
+        with statusd.StatusServer(port=0) as srv:
+            code, h = _get(srv.url + "/healthz")
+            assert code == 200 and h["ready"]
+            w.watch(("probe", 5), 5, "watchdog probe (psum over mesh axes)")
+            time.sleep(0.03)
+            assert w.check()   # fires: over-age and not ready
+            code, h = _get(srv.url + "/healthz")
+            assert code == 503 and h["live"] and not h["ready"]
+            (reason,) = h["reasons"]
+            assert reason["reason"] == "collective_stall"
+            assert "watchdog probe" in reason["in_flight"]
+            # Drain: the channel empties, the episode re-arms -> ready.
+            w.fetched(("probe", 5), 5)
+            code, h = _get(srv.url + "/healthz")
+            assert code == 200 and h["ready"] and h["reasons"] == []
+    finally:
+        w.close()
+
+
+def test_readiness_member_quarantine_all_vs_one():
+    """All members quarantined -> not ready ('all_members_quarantined');
+    a single quarantined member is degraded but READY."""
+    with statusd.StatusServer(port=0) as srv:
+        tel.emit("run_started", run="ensemble", n_steps=10, members=3)
+        tel.emit("member_quarantined", step=4, member=1, reason="retries")
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]           # 1 of 3: still serving
+        tel.emit("member_quarantined", step=6, member=0, reason="retries")
+        tel.emit("member_quarantined", step=6, member=2, reason="retries")
+        code, h = _get(srv.url + "/healthz")
+        assert code == 503
+        (reason,) = h["reasons"]
+        assert reason["reason"] == "all_members_quarantined"
+        assert reason["members"] == 3
+        code, s = _get(srv.url + "/status")
+        assert s["members"] == {"total": 3, "quarantined": [0, 1, 2]}
+        # A fresh ensemble run resets the verdict.
+        tel.emit("run_started", run="ensemble", n_steps=10, members=2)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+
+
+def test_readiness_heal_escalation():
+    with statusd.StatusServer(port=0) as srv:
+        tel.emit("run_started", run="resilient", n_steps=10)
+        tel.emit("heal_escalated", step=7, run="resilient",
+                 action="demote", escalated_from="retile",
+                 signal_reason="window_inflation", reason="escalation")
+        code, h = _get(srv.url + "/healthz")
+        assert code == 503
+        (reason,) = h["reasons"]
+        assert reason["reason"] == "heal_escalated"
+        assert reason["escalated_from"] == "retile"
+        # The escalation also lands in the /status heal ledger.
+        _, s = _get(srv.url + "/status")
+        assert any(hh["kind"] == "heal_escalated" for hh in s["heal"])
+        # A fresh run resets the terminal verdict.
+        tel.emit("run_started", run="resilient", n_steps=10)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+
+
+def test_readiness_watchdog_fetch_lag():
+    with statusd.StatusServer(port=0, max_fetch_lag=100) as srv:
+        tel.emit("run_started", run="resilient", n_steps=10_000)
+        tel.emit("step_stats", step=500, run="resilient", steps_per_s=9.0,
+                 ms_per_step=111.0, window_steps=50, fetch_lag_steps=450)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 503
+        (reason,) = h["reasons"]
+        assert reason["reason"] == "watchdog_fetch_lag"
+        assert reason["lag_steps"] == 450
+        assert reason["max_lag_steps"] == 100
+        # The watchdog catching up recovers readiness.
+        tel.emit("step_stats", step=1000, run="resilient", steps_per_s=9.0,
+                 ms_per_step=111.0, window_steps=50, fetch_lag_steps=10)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+        # ...and a FINISHED run's stale lag never trips readiness.
+        tel.emit("step_stats", step=1500, run="resilient", steps_per_s=9.0,
+                 ms_per_step=111.0, window_steps=50, fetch_lag_steps=999)
+        tel.emit("run_finished", step=10_000, run="resilient",
+                 preempted=False)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+
+
+# ---------------------------------------------------------------------------
+# (iv) the chaos liveness proof
+# ---------------------------------------------------------------------------
+
+def test_endpoint_answers_while_main_loop_is_wedged(monkeypatch):
+    """The module contract: with an injected collective stall AND the
+    main loop wedged at a dispatch boundary (chaos hold), `/metrics` and
+    `/healthz` keep answering from statusd's own threads — readiness
+    false naming the stall — and recover to ready once the episode
+    drains at end of run."""
+    monkeypatch.setenv("IGG_COMM_STALL_TIMEOUT", "0.05")
+    _grid()
+    step_fn = _make_step()
+    srv = statusd.StatusServer(port=0).start()
+    plan = igg.chaos.ChaosPlan(hold_at=[(10, 1.0)])
+    seen = []     # (code, reasons) snapshots scraped during the run
+    done = threading.Event()
+    result = {}
+
+    def scrape():
+        while not done.is_set():
+            try:
+                code, h = _get(srv.url + "/healthz")
+                mcode, _ = _get_text(srv.url + "/metrics")
+                seen.append((code, [r["reason"] for r in h["reasons"]],
+                             mcode))
+            except OSError:
+                pass
+            time.sleep(0.01)
+
+    def run():
+        with igg.chaos.collective_stall():
+            result["res"] = igg.run_resilient(
+                step_fn, _init_state(), 20, watch_every=5,
+                max_pending_probes=100, serve=srv, chaos=plan,
+                install_sigterm=False)
+
+    scraper = threading.Thread(target=scrape, daemon=True)
+    runner = threading.Thread(target=run, daemon=True)
+    scraper.start()
+    runner.start()
+    runner.join(timeout=60)
+    done.set()
+    scraper.join(timeout=10)
+    try:
+        assert not runner.is_alive()
+        assert result["res"].steps_done == 20   # the run itself completed
+        # While the loop was wedged inside the hold, the endpoint kept
+        # answering — and reported the stall with readiness false.
+        stalled = [s for s in seen if s[0] == 503]
+        assert stalled, seen
+        assert all("collective_stall" in s[1] for s in stalled)
+        assert all(s[2] == 200 for s in seen)   # /metrics never went dark
+        # The stall event itself is on the record (the heartbeat emits
+        # onto the bus; the flight ring has it).
+        assert any(r.kind == "collective_stall"
+                   for r in tel.flight_recorder())
+        # Episode over (watchdog closed at end of run): ready again.
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+    finally:
+        srv.stop()
+        igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# (v) HBM gauges: honest omission
+# ---------------------------------------------------------------------------
+
+def test_hbm_gauges_honest_omission_and_presence(monkeypatch):
+    from igg import device as idevice
+
+    # The real backend on this host (CPU) exposes no allocator stats:
+    # the poller reports None and NO igg_hbm_* gauge exists.
+    srv = statusd.StatusServer(port=0, hbm_every=0.0).start()
+    try:
+        code, body = _get_text(srv.url + "/metrics")
+        if not idevice.memory_stats():
+            assert "igg_hbm_" not in body
+            _, s = _get(srv.url + "/status")
+            assert s["hbm"] is None
+        # With a backend that DOES report (simulated), the gauges and
+        # the /status summary appear.
+        monkeypatch.setattr(idevice, "memory_stats", lambda devices=None: [
+            {"device": "tpu:0", "kind": "TPU v5p",
+             "bytes_in_use": 3 * 2**30, "bytes_limit": 16 * 2**30,
+             "peak_bytes_in_use": 5 * 2**30}])
+        code, body = _get_text(srv.url + "/metrics")
+        assert 'igg_hbm_bytes_in_use{device="tpu:0"}' in body
+        assert 'igg_hbm_bytes_limit{device="tpu:0"}' in body
+        assert 'igg_hbm_watermark_bytes{device="tpu:0"}' in body
+        _, s = _get(srv.url + "/status")
+        assert s["hbm"]["devices"] == 1
+        assert abs(s["hbm"]["pct_in_use"] - 100.0 * 3 / 16) < 1e-9
+    finally:
+        srv.stop()
+
+
+def test_hbm_poll_throttle(monkeypatch):
+    from igg import device as idevice
+
+    calls = []
+    monkeypatch.setattr(idevice, "memory_stats",
+                        lambda devices=None: calls.append(1) or [])
+    p = statusd._HbmPoller(every=1000.0)
+    p.poll()
+    p.poll()
+    p.poll()
+    assert len(calls) == 1          # throttled
+    p.poll(force=True)
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# (vi) multi-rank aggregation
+# ---------------------------------------------------------------------------
+
+def test_multi_rank_snapshot_publish_and_merge(tmp_path, monkeypatch):
+    """A non-zero rank publishes statusd_r<rank>.json; rank 0's
+    /metrics merges it into one rank-labelled exposition and /status
+    lists the rank."""
+    tel.counter("igg_steps_total", run="resilient").inc(11)
+    # Publish AS rank 1 (the publisher half of StatusServer).
+    monkeypatch.setattr(tel, "_process_cached", 1)
+    pub = statusd.StatusServer(port=0, dir=tmp_path)
+    out = pub.publish_snapshot()
+    assert out == tmp_path / "statusd_r1.json"
+    doc = json.loads(out.read_text())
+    assert doc["process"] == 1 and isinstance(doc["metrics"], list)
+    # Back to rank 0: the endpoint merges the remote snapshot.
+    monkeypatch.setattr(tel, "_process_cached", 0)
+    with statusd.StatusServer(port=0, dir=tmp_path) as srv:
+        code, body = _get_text(srv.url + "/metrics")
+        assert code == 200
+        assert 'rank="0"' in body and 'rank="1"' in body
+        # One TYPE line per name even with two ranks carrying it.
+        assert body.count("# TYPE igg_steps_total counter") == 1
+        _, s = _get(srv.url + "/status")
+        assert "1" in s["ranks"]
+    # Half-written snapshots are skipped, not fatal.
+    (tmp_path / "statusd_r2.json").write_text("{torn")
+    with statusd.StatusServer(port=0, dir=tmp_path) as srv:
+        code, body = _get_text(srv.url + "/metrics")
+        assert code == 200 and 'rank="1"' in body
+
+
+def test_publisher_thread_runs_off_rank0(tmp_path, monkeypatch):
+    monkeypatch.setattr(tel, "_process_cached", 3)
+    srv = statusd.StatusServer(port=0, dir=tmp_path, publish_every=0.02)
+    srv.start()
+    try:
+        assert srv.port is None          # no HTTP server off rank 0
+        deadline = time.monotonic() + 5
+        while (not (tmp_path / "statusd_r3.json").exists()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert (tmp_path / "statusd_r3.json").exists()
+    finally:
+        srv.stop()
+        monkeypatch.setattr(tel, "_process_cached", 0)
+
+
+def test_remote_snapshot_staleness_gate(tmp_path, monkeypatch):
+    """A dead rank's — or a previous job's, in a reused telemetry dir —
+    leftover snapshot must not merge into /metrics as live data:
+    snapshots whose wall stamp is older than a few publish periods are
+    skipped (and return once the publisher refreshes them)."""
+    tel.counter("igg_steps_total", run="resilient").inc(11)
+    monkeypatch.setattr(tel, "_process_cached", 1)
+    pub = statusd.StatusServer(port=0, dir=tmp_path)
+    out = pub.publish_snapshot()
+    monkeypatch.setattr(tel, "_process_cached", 0)
+    with statusd.StatusServer(port=0, dir=tmp_path) as srv:
+        code, body = _get_text(srv.url + "/metrics")
+        assert code == 200 and 'rank="1"' in body      # fresh: merged
+        # Age the snapshot an hour: the rank is treated as gone.
+        doc = json.loads(out.read_text())
+        doc["wall"] = time.time() - 3600
+        out.write_text(json.dumps(doc))
+        code, body = _get_text(srv.url + "/metrics")
+        assert code == 200 and 'rank="1"' not in body
+        _, s = _get(srv.url + "/status")
+        assert "1" not in s["ranks"]
+
+
+# ---------------------------------------------------------------------------
+# (vii) satellite: # HELP lines, spec-valid exposition
+# ---------------------------------------------------------------------------
+
+def _parse_exposition(text):
+    """Minimal spec parse: returns {name: (help?, type?)}; asserts every
+    sample line belongs to an announced TYPE and HELP precedes TYPE."""
+    meta = {}
+    announced = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, rest = line.split(" ", 3)
+            assert name not in announced, f"HELP after TYPE for {name}"
+            meta.setdefault(name, {})["help"] = rest
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            name, kind = parts[2], parts[3]
+            assert name not in announced, f"duplicate TYPE for {name}"
+            announced.add(name)
+            meta.setdefault(name, {})["type"] = kind
+        else:
+            name = line.split("{")[0].split(" ")[0]
+            base = name
+            for suffix in ("_count", "_sum", "_min", "_max"):
+                if name.endswith(suffix) and name[:-len(suffix)] in \
+                        announced:
+                    base = name[:-len(suffix)]
+                    break
+            assert base in announced, f"sample {name} without TYPE"
+            float(line.rsplit(" ", 1)[1])
+    return meta
+
+
+def test_prometheus_help_lines_builtin_and_custom():
+    tel.counter("igg_steps_total", run="x").inc()
+    tel.gauge("my_custom_gauge", help="A custom thing.\nSecond line",
+              kind="a").set(1.5)
+    tel.histogram("igg_checkpoint_write_seconds").observe(0.1)
+    text = tel.prometheus_text()
+    meta = _parse_exposition(text)
+    # Built-in names carry HELP from the table; the custom one from its
+    # registration; newlines are escaped per spec.
+    assert meta["igg_steps_total"]["help"].startswith("Steps completed")
+    assert meta["igg_steps_total"]["type"] == "counter"
+    assert meta["my_custom_gauge"]["help"] == r"A custom thing.\nSecond line"
+    assert meta["igg_checkpoint_write_seconds"]["type"] == "summary"
+    # Every igg_* built-in that is registered exposes a HELP line.
+    for name, m in meta.items():
+        if name.startswith("igg_"):
+            assert "help" in m, f"{name} missing HELP"
+
+
+def test_metric_samples_structured():
+    tel.counter("igg_steps_total", run="x").inc(2)
+    tel.histogram("h_lat", help="lat").observe(1.0)
+    samples = {(s["name"], tuple(sorted(s["labels"].items())))
+               : s for s in tel.metric_samples()}
+    c = samples[("igg_steps_total", (("run", "x"),))]
+    assert c["type"] == "counter" and c["value"] == 2.0
+    assert c["help"].startswith("Steps completed")
+    h = samples[("h_lat", ())]
+    assert h["type"] == "histogram" and h["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (viii) satellite: run-id'd flight dumps, merge-tool glob
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_collision_fixed(tmp_path):
+    """Two runs sharing one telemetry dir write DISTINCT dump files (the
+    second used to clobber the first); flight_dumps() finds both forms,
+    and the merge tool parses a dump passed explicitly."""
+    with tel.Telemetry(tmp_path):
+        tel.emit("run_started", run="resilient", n_steps=5)
+        tel.emit("nan_detected", step=3, counts={"T": 1})
+        first = tel.dump_flight_recorder("first failure")
+        tel.emit("run_started", run="resilient", n_steps=5)
+        second = tel.dump_flight_recorder("second failure")
+    assert len(first) == 1 and len(second) == 1
+    assert first[0] != second[0]                      # no clobber
+    assert first[0].exists() and second[0].exists()
+    # A legacy-named dump from an older build is found too.
+    legacy = tmp_path / "flight_r0.json"
+    legacy.write_text(json.dumps(
+        {"reason": "legacy", "process": 0,
+         "events": [{"kind": "legacy_marker", "wall": 1.0,
+                     "process": 0, "step": None, "payload": {}}]}))
+    found = tel.flight_dumps(tmp_path, rank=0)
+    assert set(found) == {first[0], second[0], legacy}
+    # Merge tool: a dump handed in explicitly contributes its events.
+    recs = tel.merge_streams([legacy, first[0]])
+    kinds = [r.get("kind") for r in recs]
+    assert "legacy_marker" in kinds and "nan_detected" in kinds
+
+
+# ---------------------------------------------------------------------------
+# (ix) igg.top — one renderer over both sources
+# ---------------------------------------------------------------------------
+
+def test_top_renders_live_endpoint_and_offline_dir(tmp_path, capsys):
+    with tel.Telemetry(tmp_path):
+        tel.emit("run_started", run="resilient", n_steps=100)
+        tel.emit("step_stats", step=40, run="resilient", steps_per_s=8.0,
+                 ms_per_step=125.0, window_steps=20, fetch_lag_steps=0)
+        tel.emit("checkpoint", step=40, path="/tmp/ck_000000040")
+    with statusd.StatusServer(port=0) as srv:
+        rc = itop._main([srv.url, "--once", "--plain"])
+        assert rc == 0
+        live = capsys.readouterr().out
+    assert "READY" in live and "step 40/100" in live
+    assert "HBM" in live
+    # Same renderer offline, from the artifacts alone.
+    rc = itop._main([str(tmp_path), "--once", "--plain", "-n", "5"])
+    assert rc == 0
+    offline = capsys.readouterr().out
+    assert "OFFLINE VIEW" in offline and "step 40/100" in offline
+    assert "checkpoint head: step 40" in offline
+    assert "step_stats" in offline           # the event tail renders
+    # A bad target is a clean CLI error, not a stack trace.
+    assert itop._main([str(tmp_path / "missing"), "--once"]) == 2
+
+
+def test_top_event_tail_bound():
+    for i in range(30):
+        tel.emit("step_stats", step=i, run="resilient", steps_per_s=1.0,
+                 ms_per_step=1.0, window_steps=1, fetch_lag_steps=0)
+    with statusd.StatusServer(port=0) as srv:
+        status, events = itop.fetch_endpoint(srv.url, n=7)
+        assert len(events) == 7
+        frame = itop.render(status, events, 7)
+        assert "last 7 event(s):" in frame
+
+
+def test_top_rank_skew_same_run_across_ranks_only():
+    """Two different runs' window times on one rank are NOT skew; skew
+    is the same run compared across ranks (worst vs median)."""
+    status = {"runs": {"resilient": {"ms_per_step": 125.0},
+                       "ensemble": {"ms_per_step": 10.0}},
+              "ranks": {}}
+    assert itop._rank_skew_from_status(status) is None
+    status = {"runs": {"resilient": {"ms_per_step": 10.0}},
+              "ranks": {"1": {"runs": {"resilient": {"ms_per_step": 14.0}}},
+                        "2": {"runs": {"resilient": {"ms_per_step": 10.0}}}}}
+    assert itop._rank_skew_from_status(status) == pytest.approx(4.0)
+    # The live gauge, when present, wins over the fallback.
+    assert itop._rank_skew_from_status({"rank_skew_ms": 2.5}) == 2.5
+
+
+def test_top_offline_merges_rank0_metrics_with_rank_snapshots(
+        tmp_path, monkeypatch):
+    """Rank 0 never publishes statusd_r0.json (it serves HTTP); offline,
+    its metrics_r0.jsonl must still feed the view NEXT TO other ranks'
+    snapshots — the sources merge per rank, they are not exclusive."""
+    with tel.Telemetry(tmp_path):
+        tel.gauge("igg_exposed_comm_fraction").set(0.25)
+        tel.emit("run_started", run="resilient", n_steps=10)
+    tel.reset_metrics()
+    tel.counter("igg_tier_dispatch_total", family="diffusion3d",
+                tier="diffusion3d.xla").inc(5)
+    monkeypatch.setattr(tel, "_process_cached", 1)
+    statusd.StatusServer(port=0, dir=tmp_path).publish_snapshot()
+    monkeypatch.setattr(tel, "_process_cached", 0)
+    status, _ = itop.build_from_dir(tmp_path)
+    assert status["gauges"]["igg_exposed_comm_fraction"] == 0.25  # rank 0
+    assert status["tiers"].get("diffusion3d") == "diffusion3d.xla"  # rank 1
+
+
+def test_top_live_non_json_endpoint_clean_error(capsys):
+    """igg.top pointed at a non-statusd HTTP server (one answering 200
+    with HTML) is a clean CLI error, not a JSONDecodeError traceback."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Html(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"<html>not statusd</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Html)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        assert itop._main([url, "--once"]) == 2
+        assert "did not return JSON" in capsys.readouterr().err
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# (x) run-loop wiring
+# ---------------------------------------------------------------------------
+
+def test_run_resilient_serve_knob_lifecycle():
+    """serve=<port> starts an owned endpoint for the run's duration and
+    releases it afterwards; a shared started server is left running."""
+    _grid()
+    try:
+        shared = statusd.StatusServer(port=0).start()
+        res = igg.run_resilient(_make_step(), _init_state(), 10,
+                                watch_every=5, serve=shared,
+                                install_sigterm=False)
+        assert res.steps_done == 10
+        assert shared.started                 # left running (shared)
+        _, s = _get(shared.url + "/status")
+        assert s["runs"]["resilient"]["finished"] is True
+        # tiers mirrors degrade.active() — this raw igg.sharded step has
+        # no ladder family, so the dict is present but may be empty.
+        assert isinstance(s["tiers"], dict)
+        shared.stop()
+        # env-driven off by default: serve=None with no knob set.
+        res = igg.run_resilient(_make_step(), _init_state(), 5,
+                                watch_every=5, install_sigterm=False)
+        assert res.steps_done == 5
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_run_fleet_serve_watches_journal(tmp_path):
+    """The fleet drain points /status at its queue journal: per-status
+    job counts come from the journal itself."""
+    from igg.models import diffusion3d as d3
+
+    def make_states(job):
+        params = d3.Params()
+        T0, Cp = d3.init_fields(params, dtype=np.float32)
+        return [{"T": T0, "Cp": Cp}]
+
+    def make_step(job):
+        params = d3.Params()
+        return d3.make_member_step(params)
+
+    jobs = [igg.Job(name="j1", global_interior=(8, 8, 8), members=1,
+                    n_steps=4, make_states=make_states,
+                    make_step=make_step, watch_every=0)]
+    srv = statusd.StatusServer(port=0).start()
+    try:
+        res = igg.run_fleet(jobs, tmp_path, serve=srv,
+                            install_sigterm=False)
+        assert res.jobs["j1"].status == "done"
+        _, s = _get(srv.url + "/status")
+        assert s["fleet"]["by_status"] == {"done": 1}
+        assert s["fleet"]["jobs"] == 1
+    finally:
+        srv.stop()
+
+
+def test_serve_bind_failure_does_not_leak_session(tmp_path):
+    """A port-bind failure (port already taken) raises a GridError naming
+    the address AND must not leak the run-owned telemetry session into
+    the process-global sink list."""
+    _grid()
+    blocker = statusd.StatusServer(port=0).start()
+    try:
+        with pytest.raises(igg.GridError, match="cannot bind"):
+            igg.run_resilient(_make_step(), _init_state(), 5,
+                              watch_every=5, telemetry=tmp_path,
+                              serve=blocker.port, install_sigterm=False)
+        assert tel._SESSIONS == []
+    finally:
+        blocker.stop()
+        igg.finalize_global_grid()
+
+
+def test_statusd_env_knobs_registered():
+    from igg import _env
+
+    for knob in ("IGG_STATUSD_PORT", "IGG_STATUSD_HOST",
+                 "IGG_STATUSD_HBM_EVERY", "IGG_STATUSD_MAX_FETCH_LAG",
+                 "IGG_STATUSD_PUBLISH_EVERY"):
+        assert knob in _env._KNOWN

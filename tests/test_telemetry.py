@@ -335,7 +335,9 @@ def test_resilience_error_auto_dumps_flight_recorder(tmp_path):
     with pytest.raises(igg.ResilienceError):
         igg.run_resilient(step_fn, _init_state(), 10, watch_every=5,
                           telemetry=tmp_path, chaos=plan)
-    dump = json.loads((tmp_path / "flight_r0.json").read_text())
+    dumps = tel.flight_dumps(tmp_path, rank=0)
+    assert dumps, list(tmp_path.iterdir())
+    dump = json.loads(dumps[0].read_text())
     assert "ResilienceError" in dump["reason"]
     assert any(r["kind"] == "nan_detected" for r in dump["events"])
 
@@ -362,11 +364,11 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
             fetches.append(type(x).__name__)
         return real_asarray(x, *a, **kw)
 
-    def run(telemetry, comm=None, heal=None):
+    def run(telemetry, comm=None, heal=None, serve=False):
         fetches.clear()
         igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
                           telemetry=telemetry, comm=comm, heal=heal,
-                          install_sigterm=False)
+                          serve=serve, install_sigterm=False)
         return len(fetches)
 
     monkeypatch.setattr(res_mod, "np", type(np)("np_proxy"))
@@ -421,6 +423,39 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
     with_heal = run(telemetry=tmp_path / "session4", heal=engine)
     assert with_heal == bare
     assert engine.actions == [] and not engine.has_pending()
+    # Round 18: with the STATUSD live endpoint enabled too — the health
+    # tracker is a bus-subscriber callback, the HTTP server and the HBM
+    # poller (device.memory_stats is a host-side allocator lookup) live
+    # entirely on statusd's own threads — the fetch counts are STILL
+    # identical, with a scraper hitting the endpoint mid-run.
+    import json as _json
+    import threading as _threading
+    import urllib.request
+
+    from igg import statusd as istatusd
+
+    srv = istatusd.StatusServer(port=0, hbm_every=0.0).start()
+    stop_scrape = _threading.Event()
+
+    def scrape():
+        while not stop_scrape.wait(0.02):
+            try:
+                urllib.request.urlopen(srv.url + "/metrics", timeout=2)
+                urllib.request.urlopen(srv.url + "/healthz", timeout=2)
+            except Exception:
+                continue
+
+    scraper = _threading.Thread(target=scrape, daemon=True)
+    scraper.start()
+    try:
+        with_statusd = run(telemetry=tmp_path / "session5", serve=srv)
+    finally:
+        stop_scrape.set()
+        scraper.join(timeout=5)
+    assert with_statusd == bare
+    body = urllib.request.urlopen(srv.url + "/status", timeout=2).read()
+    assert _json.loads(body)["runs"]["resilient"]["finished"] is True
+    srv.stop()
 
 
 # ---------------------------------------------------------------------------
