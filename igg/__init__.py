@@ -51,7 +51,14 @@ budget/hysteresis-governed and chaos-proven), and the live ops plane
 (`igg.statusd` — an always-on HTTP endpoint serving `/metrics`,
 `/healthz`, `/status`, and `/events` with live HBM gauges and
 multi-rank aggregation, wired via the `serve=` knob on the run loops;
-`python -m igg.top` renders it as a terminal dashboard).
+`python -m igg.top` renders it as a terminal dashboard), and the
+numeric-integrity layer (`igg.integrity` — silent-data-corruption
+defense: family-declared invariant probes and shadow re-execution
+checks fused into the watchdog's single async fetch, per-rank device
+attribution, deep-verified checkpoint rollback via
+`verify_checkpoint(deep=True)`, and the heal loop's
+fence-the-suspect-device re-tile — all chaos-provable with
+`igg.chaos.silent_corruption`/`poison_checkpoint`).
 """
 
 from ._compat import install as _compat_install
@@ -121,6 +128,7 @@ from . import device
 from . import ensemble
 from . import fleet
 from . import heal
+from . import integrity
 from . import perf
 from . import profiling
 from . import resilience
@@ -152,6 +160,7 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
-    "telemetry", "Telemetry", "perf", "comm", "heal", "autotune",
+    "telemetry", "Telemetry", "perf", "comm", "heal", "integrity",
+    "autotune",
     "statusd", "stencil", "time_steps", "__version__",
 ]

@@ -65,6 +65,18 @@ _KNOWN: Dict[str, str] = {
     "IGG_HEAL_THROUGHPUT_TOL":
         "lagging-job threshold: measured member_steps_per_s below this "
         "fraction of the expectation plans a repack (default 0.5)",
+    "IGG_INTEGRITY":
+        "1 enables the igg.integrity numeric-integrity layer on the run "
+        "loops (default off; integrity= on the run loops overrides)",
+    "IGG_INTEGRITY_CHECK_EVERY":
+        "shadow re-execution cadence in watch windows (default 4; 0 "
+        "disables the shadow spot checks)",
+    "IGG_INTEGRITY_TOL":
+        "relative invariant-drift tolerance of the integrity probes and "
+        "the deep checkpoint verify (default 1e-3)",
+    "IGG_INTEGRITY_DEEP_VERIFY":
+        "0 stops integrity-enabled rollback/resume scans from preferring "
+        "deep-verified generations (stamps are always written; default 1)",
     "IGG_NATIVE": "0 disables the native (C++) host-side runtime",
     "IGG_NATIVE_THREADS": "thread count for the native re-tile/memcopy",
     "IGG_PERF": "0 disables perf-ledger recording (igg.perf)",

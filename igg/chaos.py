@@ -59,6 +59,18 @@ one-shot by default so a rolled-back replay does not re-fail:
   tap), and :func:`stale_calibration` installs a wrong cost-model
   prediction so the next measured sample fires `cost_model_drift`.
 
+- the :mod:`igg.integrity` fault set (round 19), the silent-data-
+  corruption shapes every NaN-gated layer provably cannot see:
+  :func:`silent_corruption` perturbs one element of live state by a
+  FINITE magnitude through the `igg.resilience._CHAOS_STATE_TAP`
+  dispatch-boundary seam (detection belongs to the invariant probes /
+  shadow re-execution checks, attribution to the per-rank partials,
+  recovery to deep-verified rollback + the heal fence/re-tile), and
+  :func:`poison_checkpoint` writes finite corruption into a checkpoint
+  CONSISTENTLY through the CRC layer (container, per-array manifest,
+  and shard summary CRCs all rewritten) so structural verification
+  passes and only `verify_checkpoint(deep=True)` refuses it.
+
 Prefer the exception-safe context managers — every injector supports
 ``with`` directly, and :func:`armed` composes several — so a test failure
 mid-plan cannot leak an armed tap or stale compiled caches into the next
@@ -86,6 +98,7 @@ __all__ = ["ChaosPlan", "corrupt_checkpoint", "halo_corruption",
            "KernelChaos", "collective_stall", "FetchStall",
            "straggler", "FetchDelay", "throughput_collapse",
            "stale_calibration", "StaleCalibration",
+           "silent_corruption", "SilentCorruption", "poison_checkpoint",
            "scheduler_fault", "job_preempt_at", "JobChaos",
            "InjectedSchedulerFault", "armed"]
 
@@ -657,6 +670,227 @@ def stale_calibration(family: str, s_per_step: float) -> StaleCalibration:
     restores the pre-chaos prediction only if no recalibration
     happened."""
     return StaleCalibration(family, s_per_step)
+
+
+class SilentCorruption:
+    """Armed silent-data-corruption injection (see
+    :func:`silent_corruption`): installs a ONE-SHOT state transform into
+    the `igg.resilience._CHAOS_STATE_TAP` dispatch-boundary seam (the
+    `_CHAOS_FETCH_TAP` pattern applied to live state).  When the run
+    loop crosses `step`, one element of `state[field]` inside the block
+    of shard `rank` (or of member lane `member` on an ensemble-stacked
+    state) is perturbed by the FINITE `magnitude` — every value stays
+    finite, so the NaN watchdog is provably silent; only the
+    :mod:`igg.integrity` invariant probes / shadow re-execution checks
+    can see it.  Host-level (never traced), no cache clearing; one-shot,
+    so the rolled-back replay passes the same step clean — which is what
+    makes heal-to-bit-exact provable."""
+
+    def __init__(self, field: str, step: int, magnitude: float = 1.0,
+                 rank: int = 0, index=None, member: Optional[int] = None):
+        if not np.isfinite(magnitude) or magnitude == 0:
+            raise GridError("silent_corruption: magnitude must be a "
+                            "non-zero FINITE perturbation (NaN injection "
+                            "is ChaosPlan's job — the point here is a "
+                            "fault the NaN watchdog cannot see).")
+        self.field = str(field)
+        self.step = int(step)
+        self.magnitude = float(magnitude)
+        self.rank = int(rank)
+        self.index = tuple(index) if index is not None else None
+        self.member = int(member) if member is not None else None
+        self._fired = False
+
+    def reset(self) -> None:
+        self._fired = False
+
+    def _tap(self, state: dict, step: int, emit, span: int = 1):
+        import jax
+        import jax.numpy as jnp
+
+        from . import shared
+
+        if self._fired or not step <= self.step < step + span:
+            return state
+        self._fired = True
+        if self.field not in state:
+            raise GridError(f"silent_corruption: field {self.field!r} not "
+                            f"in state {sorted(state)}.")
+        A = state[self.field]
+        if not jnp.issubdtype(A.dtype, jnp.inexact):
+            raise GridError(f"silent_corruption: cannot perturb dtype "
+                            f"{A.dtype}.")
+        if self.member is not None:
+            if not 0 <= self.member < A.shape[0]:
+                raise GridError(
+                    f"silent_corruption: member {self.member} out of range "
+                    f"for a stacked array of {A.shape[0]} lane(s).")
+            lane = A.shape[1:]
+            idx = (self.member,) + (self.index if self.index is not None
+                                    else tuple(min(1, s - 1) for s in lane))
+        else:
+            grid = shared.global_grid()
+            coords = grid.cart_coords(self.rank)
+            local = grid.local_shape(A)
+            off = (self.index if self.index is not None
+                   else tuple(min(1, s - 1) for s in local))
+            nd = min(A.ndim, 3)
+            idx = tuple(coords[d] * local[d] + off[d] for d in range(nd)) \
+                + tuple(off[nd:])
+        out = A.at[idx].add(jnp.asarray(self.magnitude, A.dtype))
+        sharding = getattr(A, "sharding", None)
+        if sharding is not None:
+            out = jax.device_put(out, sharding)
+        state = dict(state)
+        state[self.field] = out
+        detail = {"field": self.field, "magnitude": self.magnitude,
+                  "index": list(idx)}
+        if self.member is not None:
+            detail["member"] = self.member
+        else:
+            detail["rank"] = self.rank
+        emit("chaos_silent_corruption", step, **detail)
+        return state
+
+    def arm(self) -> "SilentCorruption":
+        from . import resilience
+
+        self._fired = False
+        resilience._CHAOS_STATE_TAP = self._tap
+        return self
+
+    def disarm(self) -> None:
+        from . import resilience
+
+        resilience._CHAOS_STATE_TAP = None
+
+    def __enter__(self) -> "SilentCorruption":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def silent_corruption(field: str, step: int, magnitude: float = 1.0,
+                      rank: int = 0, index=None,
+                      member: Optional[int] = None) -> SilentCorruption:
+    """Context manager injecting SILENT data corruption: at dispatch
+    step `step`, one element of `state[field]` inside shard `rank`'s
+    block (default: an interior cell) is perturbed by the finite
+    `magnitude` through the `igg.resilience._CHAOS_STATE_TAP` seam — the
+    deterministic stand-in for an HBM bit-flip or a flaky chip's
+    finite-but-wrong arithmetic.  Every value stays FINITE, so the PR-3
+    NaN watchdog is provably silent; detection belongs to the
+    :mod:`igg.integrity` layer (invariant drift within one watch window,
+    or a shadow re-execution diff within one check window), attribution
+    to the per-rank partial sums, and recovery to the deep-verified
+    rollback + the heal loop's fence-and-re-tile::
+
+        with igg.chaos.silent_corruption("T", step=40, magnitude=50.0,
+                                         rank=3):
+            res = igg.run_resilient(step, state, n, watch_every=10,
+                                    integrity=True, ...)
+
+    `member` targets one lane of an ensemble-stacked state instead
+    (`index` then indexes within the lane) — the per-member isolation
+    shape of :func:`igg.run_ensemble`.  One-shot: the rolled-back replay
+    passes the same step clean."""
+    return SilentCorruption(field, step, magnitude, rank=rank, index=index,
+                            member=member)
+
+
+def poison_checkpoint(path, *, field: Optional[str] = None,
+                      magnitude: float = 1.0, seed: int = 0,
+                      shard: int = 0) -> None:
+    """Deterministically poison a checkpoint with FINITE-valued
+    corruption written consistently through the CRC layer — the on-disk
+    sibling of :func:`silent_corruption` and the deep-verify chaos
+    shape: one element of one array is perturbed by `magnitude` (in
+    value space — the true dtype), the per-array CRC32 manifest (and,
+    on a sharded generation, the manifest's shard summary CRC) is
+    REWRITTEN to match the new bytes, and the round-19 deep stamps are
+    left untouched.  Structural verification and `check_finite` then
+    PASS — only ``verify_checkpoint(deep=True)`` refuses the
+    generation, which is exactly the layer under test
+    (`tests/test_integrity.py` proves the non-deep scan serves the
+    poisoned generation and the deep scan skips it).
+
+    On a flat `.npz`, `field` picks the member (default: the first
+    non-meta array, sorted) and `seed` the element; on a sharded
+    generation directory the corruption hits `shard_<shard>.npz`."""
+    import json
+
+    from .checkpoint import (_MANIFEST, _shard_name, _summary_crc,
+                             _write_atomic_text)
+
+    path = pathlib.Path(path)
+    if path.is_dir():
+        sp = path / _shard_name(shard)
+        if not sp.exists():
+            raise GridError(f"poison_checkpoint: generation {path} has no "
+                            f"{sp.name}.")
+        mp = path / _MANIFEST
+        man = json.loads(mp.read_text())
+        new_crcs = _poison_npz(sp, field, magnitude, seed, geom=man)
+        man["shards"][sp.name] = _summary_crc(new_crcs)
+        _write_atomic_text(mp, json.dumps(man))
+        return
+    _poison_npz(path, field, magnitude, seed)
+
+
+def _poison_npz(path, field, magnitude, seed, geom=None) -> dict:
+    """Perturb one OWNED element of one array inside an igg npz (flat
+    checkpoint or shard file — an overlap copy would be invisible to the
+    owned-cell deep stamps, and real corruption of a duplicated cell is
+    healed by the next exchange anyway), rewriting the meta CRC32
+    manifest consistently; returns the new per-array CRC map.  `geom` is
+    the generation manifest for a shard file (grid geometry lives there;
+    a flat file's own meta carries it)."""
+    import json
+
+    from .checkpoint import (_crc32, _decode, _encode, _META_KEY,
+                             _owned_slice, _write_npz)
+
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    victims = sorted(n for n in arrays)
+    name = field if field is not None else victims[0]
+    if name not in arrays:
+        raise GridError(f"poison_checkpoint: no array {name!r} in {path} "
+                        f"(has {victims}).")
+    dec = np.array(_decode(arrays[name], meta.get("dtypes", {}).get(name),
+                           path, name))
+    if dec.dtype.kind in "biu":
+        raise GridError(f"poison_checkpoint: array {name!r} has integral "
+                        f"dtype {dec.dtype}; pick a floating field.")
+    if geom is not None:
+        # Shard file: its owned region per the manifest geometry.
+        coords = meta.get("coords", [0, 0, 0])
+        sl = _owned_slice(dec.shape, coords, geom)
+    else:
+        # Flat stacked array: block (0, ..) sits at offset 0, so its
+        # owned slice indexes the stacked array directly.
+        local = [dec.shape[d] // meta["dims"][d]
+                 for d in range(min(dec.ndim, 3))]
+        sl = _owned_slice(local, (0,) * len(local), meta) \
+            + (slice(None),) * (dec.ndim - len(local))
+    owned = np.zeros(dec.shape, dtype=bool)
+    owned[sl] = True
+    idxs = np.flatnonzero(owned)
+    pos = int(idxs[np.random.default_rng(seed).integers(0, idxs.size)])
+    flat = dec.reshape(-1)
+    flat[pos] = flat[pos] + np.asarray(magnitude, dec.dtype)
+    if not np.isfinite(np.float64(flat[pos])):
+        raise GridError("poison_checkpoint: the perturbation overflowed to "
+                        "non-finite — pick a smaller magnitude (the point "
+                        "is corruption check_finite cannot see).")
+    enc = _encode(np.ascontiguousarray(dec))
+    arrays[name] = enc
+    meta.setdefault("crc32", {})[name] = _crc32(enc)
+    _write_npz(path, {**arrays, _META_KEY: np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)})
+    return {k: int(v) for k, v in meta.get("crc32", {}).items()}
 
 
 class JobChaos:

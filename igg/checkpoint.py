@@ -132,6 +132,13 @@ _logged_flat_fallback = False
 # crashed writer left behind mid-`_write_npz`/mid-commit.
 _warned_stale_tmp = False
 
+# Deep-stamp format version (round 19): per-field owned-cell moment sums
+# (+ the active invariants' references) written into the flat meta /
+# sharded manifests so `verify_checkpoint(deep=True)` can refuse a
+# finite-but-poisoned or silently-corrupted generation that the CRC32
+# layer and the all-finite gate both wave through.
+_DEEP_V = 1
+
 
 def _crc32(arr: np.ndarray) -> int:
     """CRC32 of an array's raw bytes (C-order).  Cheap relative to the
@@ -273,6 +280,131 @@ def _decode(arr: np.ndarray, want: Optional[str], path, name: str):
     return arr
 
 
+# ---------------------------------------------------------------------------
+# Deep stamps (round 19): owned-cell moment sums + invariant references
+# ---------------------------------------------------------------------------
+
+def _real_view(a: np.ndarray) -> np.ndarray:
+    """A real-valued view for the deep moment sums: complex splits into
+    interleaved re/im floats, bool widens to uint8; everything else is
+    summed as-is (in float64).  Purely a deterministic digest basis."""
+    if a.dtype.kind == "c":
+        return a.view(np.dtype(f"f{a.dtype.itemsize // 2}"))
+    if a.dtype.kind == "b":
+        return a.astype(np.uint8)
+    return a
+
+
+def _deep_stats(a: np.ndarray) -> np.ndarray:
+    """``[sum, abs_sum, sum_sq]`` of an (owned-slice, true-dtype) array
+    in float64 — deterministic for a given array (numpy's pairwise
+    summation is shape-fixed), so recomputing at verify time reproduces
+    the stamp bit-for-bit unless the bytes changed."""
+    x = np.asarray(_real_view(np.asarray(a)), dtype=np.float64)
+    return np.array([x.sum(), np.abs(x).sum(), (x * x).sum()],
+                    dtype=np.float64)
+
+
+def _owned_slice(shape, coords, meta) -> tuple:
+    """Owned-cell slice of one local block (halo cells included in
+    `shape`): along each sharded dim the block owns its first
+    ``s − ol`` cells, the LAST block of a non-periodic dim owning all
+    ``s`` — exactly the :func:`_redistribute` de-duplication, so the
+    union over blocks is the global interior, each cell once."""
+    sl = []
+    for d in range(min(len(shape), NDIMS)):
+        s = int(shape[d])
+        ol = meta["overlaps"][d] + (s - meta["nxyz"][d])
+        keep = s - max(ol, 0)
+        last = coords[d] == meta["dims"][d] - 1
+        sl.append(slice(0, s if (last and not meta["periods"][d]) else keep))
+    return tuple(sl) + (slice(None),) * (len(shape) - len(sl))
+
+
+def _deep_sums_stacked(arr: np.ndarray, meta: dict) -> np.ndarray:
+    """Dedup moment sums of a block-STACKED global array (the flat
+    format): per-block owned slices accumulated in block-rank order."""
+    nd = min(arr.ndim, NDIMS)
+    local = [arr.shape[d] // meta["dims"][d] for d in range(nd)]
+    tot = np.zeros(3, dtype=np.float64)
+    for coords in itertools.product(
+            *[range(meta["dims"][d]) for d in range(nd)]):
+        block = arr[tuple(slice(c * local[d], (c + 1) * local[d])
+                          for d, c in enumerate(coords)) or (Ellipsis,)]
+        tot += _deep_stats(block[_owned_slice(block.shape, coords, meta)])
+    return tot
+
+
+def _stamp_invariants() -> Optional[list]:
+    """The active run's invariant stamp entries (igg.integrity's stamp
+    context) — None outside an integrity-enabled run.  Lazy import so
+    the checkpoint layer never pays for (or cycles with) the integrity
+    module."""
+    try:
+        from . import integrity
+    except ImportError:       # pragma: no cover - integrity always ships
+        return None
+    return integrity.stamp_entries()
+
+
+def _deep_meta(sums: Dict[str, list]) -> dict:
+    deep = {"v": _DEEP_V, "sums": {n: [float(v) for v in s]
+                                   for n, s in sums.items()}}
+    inv = _stamp_invariants()
+    if inv:
+        deep["invariants"] = inv
+    return deep
+
+
+def _close(a, b) -> bool:
+    return bool(np.isclose(float(a), float(b), rtol=1e-9, atol=1e-12,
+                           equal_nan=True))
+
+
+def _stats_match(got: np.ndarray, want) -> bool:
+    want = np.asarray(want, dtype=np.float64)
+    return want.shape == (3,) and all(_close(g, w)
+                                      for g, w in zip(got, want))
+
+
+def _derive_invariant(entry: dict, sums: Dict[str, list]):
+    """(value, present) of one stamped invariant from per-field moment
+    sums: moment 1 reads the plain sums, moment 2 the sums of squares
+    (``Σ f^m`` over the invariant's fields)."""
+    idx = 0 if int(entry.get("moment", 1)) == 1 else 2
+    total = 0.0
+    for f in entry.get("fields", ()):
+        s = sums.get(f)
+        if s is None or len(s) < 3:
+            return 0.0, False
+        total += float(s[idx])
+    return total, True
+
+
+def _invariants_ok(deep: dict) -> bool:
+    """The drift half of deep verification: every stamped invariant
+    whose reference is present must sit within its tolerance of that
+    reference — the gate that refuses a generation saved from
+    finite-but-poisoned state (its content stamps are self-consistent;
+    its physics is not)."""
+    for entry in deep.get("invariants") or ():
+        ref, scale = entry.get("ref"), entry.get("scale")
+        if ref is None:
+            continue   # stamped before the run anchored its references
+        value, present = _derive_invariant(entry, deep.get("sums", {}))
+        if not present:
+            return False
+        tol = float(entry.get("tol", 1e-3))
+        bound = tol * max(float(scale or 0.0), 1e-30)
+        drift = value - float(ref)
+        if entry.get("kind") == "bounded":
+            if drift > bound:
+                return False
+        elif abs(drift) > bound:
+            return False
+    return True
+
+
 def save_checkpoint(path, /, **fields) -> None:
     """Write the named grid fields and the grid geometry to `path` (.npz) —
     the legacy FLAT single-file format (see
@@ -306,6 +438,8 @@ def save_checkpoint(path, /, **fields) -> None:
     t_start = time.monotonic()
     host: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
+    deep_sums: Dict[str, list] = {}
+    gmeta = _meta(grid)
     for name, A in fields.items():
         if name == _META_KEY:
             raise GridError(f"save_checkpoint: field name {_META_KEY!r} is "
@@ -313,13 +447,18 @@ def save_checkpoint(path, /, **fields) -> None:
         dtypes[name] = str(np.dtype(A.dtype))
         arr = _fetch_global(A)   # None on non-root multi-controller ranks
         if arr is not None:
-            host[name] = _encode(np.ascontiguousarray(arr))
+            arr = np.ascontiguousarray(arr)
+            # Deep stamp over the TRUE-dtype array before byte-encoding:
+            # verification decodes first, so the recompute matches.
+            deep_sums[name] = _deep_sums_stacked(arr, gmeta).tolist()
+            host[name] = _encode(arr)
 
     if jax.process_index() == 0:
         path = pathlib.Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         _sweep_stale_tmp(path.parent)
-        meta = {**_meta(grid), "dtypes": dtypes,
+        meta = {**gmeta, "dtypes": dtypes,
+                "deep": _deep_meta(deep_sums),
                 "crc32": {name: _crc32(arr) for name, arr in host.items()}}
         _write_npz(path, {**host, _META_KEY: np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8)})
@@ -454,7 +593,8 @@ def _all_finite(arrays: Dict[str, np.ndarray]) -> bool:
 
 
 def verify_checkpoint(path, *, check_finite: bool = False,
-                      part: Optional[Tuple[int, int]] = None) -> bool:
+                      part: Optional[Tuple[int, int]] = None,
+                      deep: bool = False) -> bool:
     """Whether `path` is a readable, checksum-consistent checkpoint — a
     flat `.npz` file or a sharded generation directory (auto-detected).
 
@@ -471,15 +611,29 @@ def verify_checkpoint(path, *, check_finite: bool = False,
     round-robin of :func:`verify_checkpoint_distributed`; ignored for flat
     files, which have no shards to split).  Purely host-side (no grid
     needs to be initialized); peak staging on a sharded generation is one
-    shard."""
+    shard.
+
+    `deep=True` (round 19) is STRICT numeric-integrity verification: the
+    checkpoint must carry the deep stamp (per-field owned-cell moment
+    sums — written by every post-round-19 save), every stamped sum must
+    match a recompute from the stored arrays (refusing finite-valued
+    corruption written consistently through the CRC layer, the
+    ``igg.chaos.poison_checkpoint`` shape), and every stamped invariant
+    reference must sit within its tolerance (refusing a generation saved
+    from finite-but-POISONED state — its stamps are self-consistent, its
+    physics drifted).  Pre-round-19 checkpoints have no stamp and verify
+    False under `deep=True`; callers that *prefer* deep-verified
+    generations scan deep first and fall back (the
+    :mod:`igg.resilience` rollback contract)."""
     path = pathlib.Path(path)
     if path.is_dir():
-        return _verify_sharded(path, check_finite=check_finite, part=part)
+        return _verify_sharded(path, check_finite=check_finite, part=part,
+                               deep=deep)
     try:
         meta, arrays = _read_verified(path)
     except GridError:
         return False
-    if not check_finite:
+    if not (check_finite or deep):
         return True
     dtypes = meta.get("dtypes", {})
     try:
@@ -491,10 +645,24 @@ def verify_checkpoint(path, *, check_finite: bool = False,
         # "not a valid checkpoint", never kill the skip-corrupt fallback in
         # the callers.
         return False
-    return _all_finite(decoded)
+    if check_finite and not _all_finite(decoded):
+        return False
+    if deep:
+        dm = meta.get("deep")
+        if not isinstance(dm, dict) or not isinstance(dm.get("sums"), dict):
+            return False   # unstamped (pre-round-19): deep cannot vouch
+        sums = dm["sums"]
+        for n, a in decoded.items():
+            if n not in sums or not _stats_match(
+                    _deep_sums_stacked(a, meta), sums[n]):
+                return False
+        if not _invariants_ok(dm):
+            return False
+    return True
 
 
-def verify_checkpoint_distributed(path, *, check_finite: bool = False) -> bool:
+def verify_checkpoint_distributed(path, *, check_finite: bool = False,
+                                  deep: bool = False) -> bool:
     """Collective variant of :func:`verify_checkpoint` for multi-controller
     runs: each process verifies a round-robin subset of a sharded
     generation's shards and the per-process verdicts are AND-combined, so
@@ -515,9 +683,10 @@ def verify_checkpoint_distributed(path, *, check_finite: bool = False) -> bool:
     path = pathlib.Path(path)
     nproc = int(jax.process_count())
     if nproc == 1:
-        return verify_checkpoint(path, check_finite=check_finite)
+        return verify_checkpoint(path, check_finite=check_finite, deep=deep)
     part = ((int(jax.process_index()), nproc) if path.is_dir() else None)
-    ok = verify_checkpoint(path, check_finite=check_finite, part=part)
+    ok = verify_checkpoint(path, check_finite=check_finite, part=part,
+                           deep=deep)
     return _combine_verdicts(ok)
 
 
@@ -623,7 +792,8 @@ def prune_generations(directory, prefix: str, ring: int,
 def latest_checkpoint(directory, prefix: str = "ckpt", *,
                       check_finite: bool = False,
                       distributed: bool = False,
-                      max_step: Optional[int] = None
+                      max_step: Optional[int] = None,
+                      deep: bool = False
                       ) -> Optional[pathlib.Path]:
     """Newest valid checkpoint generation in `directory`.
 
@@ -648,7 +818,14 @@ def latest_checkpoint(directory, prefix: str = "ckpt", *,
     remaining candidates), so every process executes the same collectives
     in the same order.  A generation one process cannot see verifies False
     there and the AND-combine skips it everywhere — conservative, never
-    divergent."""
+    divergent.
+
+    `deep=True` scans with STRICT deep verification (numeric-integrity
+    stamps recomputed, invariant drift gated — see
+    :func:`verify_checkpoint`); unstamped pre-round-19 generations are
+    then skipped, so callers that merely PREFER deep-verified generations
+    run a `deep=True` scan first and fall back to the plain scan — the
+    mixed stamped/unstamped ordering contract of :mod:`igg.resilience`."""
     import jax
 
     gens = [(s, p) for s, p in list_generations(directory, prefix)
@@ -659,7 +836,8 @@ def latest_checkpoint(directory, prefix: str = "ckpt", *,
         # run); one of them failing must not mask the other.
         for _, p in reversed(gens):
             if (verify_checkpoint_distributed if distributed
-                    else verify_checkpoint)(p, check_finite=check_finite):
+                    else verify_checkpoint)(p, check_finite=check_finite,
+                                            deep=deep):
                 return p
         return None
 
@@ -685,6 +863,7 @@ def latest_checkpoint(directory, prefix: str = "ckpt", *,
             is_dir = cand.is_dir()
             ok = (cand.exists()
                   and verify_checkpoint(cand, check_finite=check_finite,
+                                        deep=deep,
                                         part=((int(jax.process_index()),
                                                int(jax.process_count()))
                                               if is_dir else None)))
@@ -1051,20 +1230,31 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
     local_shapes = {n: [int(v) for v in grid.local_shape(A)]
                     for n, A in fields.items()}
     refs = _local_block_refs(grid, fields)
+    gmeta = _meta(grid)
     my_crcs: Dict[int, Dict[str, int]] = {}
+    my_deep: Dict[int, Dict[str, list]] = {}
     for rank in sorted(refs):
         # One shard at a time: fetch (largest-dim slabs above _CHUNK_BYTES),
         # CRC, write, release — peak host staging is one block set.
         host: Dict[str, np.ndarray] = {}
         crcs: Dict[str, int] = {}
+        deep: Dict[str, list] = {}
+        coords = grid.cart_coords(rank)
         for name in sorted(refs[rank]):
-            arr = _encode(np.ascontiguousarray(
-                _slabbed_get(refs[rank][name], _CHUNK_BYTES)))
+            raw = np.ascontiguousarray(
+                _slabbed_get(refs[rank][name], _CHUNK_BYTES))
+            # Deep stamp: owned-cell moment sums of the TRUE-dtype block
+            # (verification decodes before recomputing, so they match).
+            deep[name] = _deep_stats(
+                raw[_owned_slice(raw.shape, coords, gmeta)]).tolist()
+            arr = _encode(raw)
             crcs[name] = _crc32(arr)
             host[name] = arr
             written_bytes += arr.nbytes
-        smeta = {"shard": rank, "coords": list(grid.cart_coords(rank)),
-                 "dtypes": {n: dtypes[n] for n in host}, "crc32": crcs}
+        smeta = {"shard": rank, "coords": list(coords),
+                 "dtypes": {n: dtypes[n] for n in host}, "crc32": crcs,
+                 "deep": deep}
+        my_deep[rank] = deep
         _write_npz(staging / _shard_name(rank), {
             **host, _META_KEY: np.frombuffer(
                 json.dumps(smeta).encode(), dtype=np.uint8)})
@@ -1089,18 +1279,36 @@ def save_checkpoint_sharded(path, /, **fields) -> None:
                      "shard/handshake",
                      on_poll=lambda: _ack_hellos(staging, token))
         shards = {}
+        deep_sums: Dict[str, np.ndarray] = {}
+        deep_whole = True
         for r in expected:
             crcs = my_crcs.get(r)
+            deep = my_deep.get(r)
             if crcs is None:
-                crcs = _read_shard_meta(staging / _shard_name(r)).get(
-                    "crc32", {})
+                peer = _read_shard_meta(staging / _shard_name(r))
+                crcs = peer.get("crc32", {})
+                deep = peer.get("deep")
             shards[_shard_name(r)] = _summary_crc(crcs)
+            # Manifest deep stamp: element-wise sums of the per-shard
+            # owned-cell partials.  A shard without one (a version-skewed
+            # peer writer) drops the manifest stamp entirely — a partial
+            # stamp would verify against a lie.
+            if deep is None:
+                deep_whole = False
+            elif deep_whole:
+                for name, stats in deep.items():
+                    acc = deep_sums.setdefault(
+                        name, np.zeros(3, dtype=np.float64))
+                    acc += np.asarray(stats, dtype=np.float64)
         for e in list(staging.iterdir()):
             if re.fullmatch(r"(hello_\d+|ack_\d+|done_\d+)(\.tmp)?", e.name):
                 e.unlink()
-        manifest = {"format": _FORMAT, **_meta(grid), "dtypes": dtypes,
+        manifest = {"format": _FORMAT, **gmeta, "dtypes": dtypes,
                     "local_shapes": local_shapes, "shards": shards,
                     "attempt": token}
+        if deep_whole:
+            manifest["deep"] = _deep_meta(
+                {n: s.tolist() for n, s in deep_sums.items()})
         # durable=True: the manifest IS the generation's commit record —
         # fsync before the rename, so a power cut mid-seal can never
         # leave a manifest name pointing at torn bytes (the same
@@ -1273,14 +1481,29 @@ def _read_manifest_verified(path: pathlib.Path) -> dict:
 
 
 def _verify_sharded(path: pathlib.Path, *, check_finite: bool,
-                    part: Optional[Tuple[int, int]] = None) -> bool:
+                    part: Optional[Tuple[int, int]] = None,
+                    deep: bool = False) -> bool:
     """Directory branch of :func:`verify_checkpoint`: manifest present and
     well-formed, every (selected) shard present, readable, and CRC- and
     summary-consistent; `check_finite` gates each shard's decoded arrays —
-    one shard in memory at a time."""
+    one shard in memory at a time.
+
+    `deep=True` additionally requires the round-19 integrity stamps:
+    every (selected) shard's owned-cell moment sums must match a
+    recompute from its decoded blocks, the manifest must carry the
+    summed stamp, and every stamped invariant reference must hold
+    (:func:`_invariants_ok`).  The invariant check is pure manifest
+    arithmetic, so a `part`-restricted distributed verification still
+    gates it on every process."""
     try:
         man = _read_manifest_verified(path)
     except GridError:
+        return False
+    deep_man = man.get("deep") if deep else None
+    if deep and (not isinstance(deep_man, dict)
+                 or not isinstance(deep_man.get("sums"), dict)):
+        return False   # unstamped (pre-round-19 or skewed-writer) gen
+    if deep and not _invariants_ok(deep_man):
         return False
     names = sorted(man["shards"])
     if part is not None:
@@ -1288,11 +1511,23 @@ def _verify_sharded(path: pathlib.Path, *, check_finite: bool,
         names = names[i::n]
     for fname in names:
         try:
-            _, arrays = _read_shard(path, fname, man)
+            smeta, arrays = _read_shard(path, fname, man)
         except GridError:
             return False
         if check_finite and not _all_finite(arrays):
             return False
+        if deep:
+            stamped = smeta.get("deep")
+            if not isinstance(stamped, dict):
+                return False
+            coords = smeta.get("coords")
+            if coords is None:
+                return False
+            for n2, a in arrays.items():
+                if n2 not in stamped or not _stats_match(
+                        _deep_stats(a[_owned_slice(a.shape, coords, man)]),
+                        stamped[n2]):
+                    return False
     return True
 
 

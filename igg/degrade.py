@@ -304,6 +304,33 @@ def dispatch_stamp() -> int:
     return _DISPATCHES
 
 
+def diagnostic_dispatches():
+    """Context manager under which ladder dispatches do NOT update the
+    per-family active-tier records (they are snapshotted on entry and
+    restored on exit).  For DIAGNOSTIC re-executions — the
+    :mod:`igg.integrity` shadow replay runs the family's truth step
+    between two serving dispatches, and without this guard the truth
+    rung would look like the serving tier to :func:`demote_active`
+    (nothing left to demote) and to the perf ledger's watchdog-window
+    attribution (diagnostic work booked as serving throughput)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        with _lock:
+            act, stamps = dict(_ACTIVE), dict(_ACTIVE_STAMP)
+        try:
+            yield
+        finally:
+            with _lock:
+                _ACTIVE.clear()
+                _ACTIVE.update(act)
+                _ACTIVE_STAMP.clear()
+                _ACTIVE_STAMP.update(stamps)
+
+    return _ctx()
+
+
 def demote_active(reason: str = "nan_recurrence",
                   error_text: Optional[str] = None,
                   since: Optional[int] = None) -> List[str]:

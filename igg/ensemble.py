@@ -78,6 +78,7 @@ import numpy as np
 from . import shared
 from . import telemetry as _telemetry
 from .shared import AXIS_NAMES, GridError
+from . import resilience as _resilience
 from .resilience import Event, ResilienceError, _is_ready, _preempt, \
     clear_preemption, request_preemption
 
@@ -273,21 +274,35 @@ def _build_step(step_fn: Callable, pk: _Packing, keys, ndims: Dict[str, int],
     return jax.jit(sm)
 
 
-def _build_probe(pk: _Packing, watch, ndims: Dict[str, int]):
+def _build_probe(pk: _Packing, watch, ndims: Dict[str, int],
+                 probe_fields=None, invariants=()):
     """The per-member health probe: one fused pass per watched field
     computing its non-finite count per member — reduced over GRID axes
     only, so the result is an `(n_fields, M)` matrix attributing any
     blowup to its member on device.  Grid packing psums over the mesh
     (replicated result); batch packing keeps the member axis sharded (no
-    collective at all)."""
+    collective at all).
+
+    With `invariants` (round 19 — the :mod:`igg.integrity` layer), the
+    matrix gains ``2·n_inv`` ROWS: each invariant's per-member owned-cell
+    value and scale sums (``Σ f^m`` / ``Σ|f|^m`` over the de-duplicated
+    grid cells of the member's lane), fused into the SAME probe program
+    and fetched by the SAME single async fetch — finite-but-wrong lanes
+    become attributable with zero additional host syncs.  `probe_fields`
+    widens the input set past `watch` when an invariant reads an
+    unwatched field."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    fields = list(probe_fields) if probe_fields is not None else list(watch)
+
     def probe(*arrays):
+        by_field = dict(zip(fields, arrays))
         counts = []
-        for a in arrays:
+        for k in watch:
+            a = by_field[k]
             if jnp.issubdtype(a.dtype, jnp.inexact):
                 c = jnp.sum((~jnp.isfinite(a)).astype(jnp.float32),
                             axis=tuple(range(1, a.ndim)))
@@ -296,9 +311,16 @@ def _build_probe(pk: _Packing, watch, ndims: Dict[str, int]):
             if pk.name == "grid":
                 c = lax.psum(c, AXIS_NAMES)
             counts.append(c)
-        return jnp.stack(counts)
+        rows = list(counts)
+        if invariants:
+            from . import integrity as _integrity
 
-    in_specs = tuple(pk.spec(ndims[k]) for k in watch)
+            grid = shared.global_grid()
+            rows.extend(_integrity.member_invariant_rows(
+                invariants, by_field, pk.name, grid))
+        return jnp.stack(rows)
+
+    in_specs = tuple(pk.spec(ndims[k]) for k in fields)
     out_specs = P(None, "member") if pk.name == "batch" else P()
     sm = jax.shard_map(probe, mesh=pk.mesh, in_specs=in_specs,
                        out_specs=out_specs)
@@ -474,6 +496,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                  on_event: Optional[Callable[[Event], None]] = None,
                  telemetry=None,
                  serve=None,
+                 integrity=None,
                  chaos=None) -> EnsembleResult:
     """Drive M independent members of `step_fn` for `n_steps` steps in ONE
     compiled program with per-member fault isolation (module docstring for
@@ -517,6 +540,20 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
       driven, int port, True, shared server, or False).  `/healthz`
       readiness flips false when EVERY member is quarantined — the
       batch has nothing left to serve.
+    - `integrity`: the numeric-integrity layer (:mod:`igg.integrity` —
+      the :func:`igg.run_resilient` contract: None = ``IGG_INTEGRITY``-
+      driven, True, an :class:`igg.integrity.IntegrityConfig`, False).
+      At the ensemble tier it is the PER-MEMBER invariant probe: each
+      registered/declared invariant contributes per-member owned-cell
+      value/scale rows to the watchdog matrix (same fused program, same
+      single async fetch — zero extra host syncs), and a member whose
+      invariant drifts past tolerance raises ``integrity_violation``
+      attributed to its LANE and rides the per-member rollback/
+      quarantine machinery exactly like a NaN verdict.  Shadow
+      re-execution checks and deep-verified generation scans are the
+      `run_resilient` half of the contract (lane scans stay
+      finite-gated; generations are still deep-STAMPED for offline
+      audit).  Requires `watch_every` > 0.
     - `chaos`: an :class:`igg.chaos.ChaosPlan`; member-targeted entries
       `(step, member, field)` poison one member's lane.
 
@@ -602,6 +639,31 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
         raise GridError(f"run_ensemble: watch_fields {missing} not in "
                         f"state {keys}.")
 
+    # Numeric-integrity layer (igg.integrity): per-member invariant rows
+    # fused into the watchdog probe matrix.
+    from . import integrity as _integrity
+
+    int_cfg = _integrity.as_config(integrity)
+    if int_cfg is not None and not (watch and watch_every):
+        raise GridError(
+            "run_ensemble: the integrity= probes ride the watch cadence; "
+            "set watch_every > 0 (with watched fields).")
+    invariants = ()
+    memrefs = None
+    if int_cfg is not None:
+        if int_cfg.invariants is not None:
+            invariants = tuple(int_cfg.invariants)
+            bad_inv = [i.name for i in invariants
+                       if not set(i.fields) <= set(state)]
+            if bad_inv:
+                raise GridError(
+                    f"run_ensemble: invariant(s) {bad_inv} name fields not "
+                    f"in the member state {sorted(state)}.")
+        else:
+            invariants = _integrity.match_invariants(state, grid)
+        memrefs = _integrity.MemberRefs(invariants, members,
+                                        int_cfg.resolved_tol())
+
     pk = _choose_packing(grid, members, packing, devices)
     state = pk.put_state(state)
 
@@ -640,6 +702,12 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
     _telemetry.emit("run_started", run="ensemble", n_steps=n_steps,
                     members=members, packing=pk.name,
                     watch_every=watch_every, steps_per_call=steps_per_call)
+    if memrefs is not None:
+        _telemetry.emit("integrity_config", run="ensemble",
+                        invariants=[i.name for i in invariants],
+                        members=members, tol=int_cfg.resolved_tol(),
+                        check_every=0, deep_verify=False,
+                        shadow="off")
     # Perf-ledger context (igg.perf): the packed member-stacked block is
     # the served shape — attribution mirrors run_resilient's (host-side
     # ladder stamps on the existing fetch timestamps, zero extra syncs).
@@ -721,7 +789,11 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                     ckpt.remove_generation(old)
 
         estep = _build_step(step_fn, pk, keys, ndims, steps_per_call)
-        eprobe = (_build_probe(pk, watch, ndims)
+        probe_fields = list(watch) + [
+            f for inv in invariants for f in inv.fields if f not in watch]
+        probe_fields = list(dict.fromkeys(probe_fields))
+        eprobe = (_build_probe(pk, watch, ndims, probe_fields=probe_fields,
+                               invariants=invariants)
                   if (watch and watch_every) else None)
     except BaseException as e:
         _telemetry._auto_dump(f"run_ensemble: {type(e).__name__}: {e}")
@@ -779,7 +851,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
             _jax.block_until_ready(state[keys[0]])
 
     def _enqueue_probe(step, verdict_lanes: np.ndarray) -> None:
-        pending.append((step, eprobe(*[state[k] for k in watch]),
+        pending.append((step, eprobe(*[state[k] for k in probe_fields]),
                         np.array(verdict_lanes)))
 
     def _poll_probes(drain: bool = False) -> Optional[Event]:
@@ -793,19 +865,34 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                     and not _is_ready(counts)):
                 return None
             pending.popleft()
-            host = np.asarray(counts)             # (n_fields, M)
+            host = np.asarray(counts)     # (n_fields [+ 2·n_inv], M)
             lanes = lanes & valid                 # quarantines since enqueue
+            nf = host[:len(watch)]
             bad_members = sorted(
                 int(m) for m in range(members)
-                if lanes[m] and host[:, m].sum() != 0)
+                if lanes[m] and nf[:, m].sum() != 0)
             if bad_members:
-                bad = {f: {int(m): int(host[i, m]) for m in bad_members
-                           if host[i, m]}
+                bad = {f: {int(m): int(nf[i, m]) for m in bad_members
+                           if nf[i, m]}
                        for i, f in enumerate(watch)
-                       if any(host[i, m] for m in bad_members)}
+                       if any(nf[i, m] for m in bad_members)}
                 pending.clear()
                 return _emit("member_diverged", step_p,
                              members=bad_members, counts=bad)
+            if memrefs is not None:
+                # Per-member invariant drift (igg.integrity): a lane whose
+                # conserved/bounded quantity moved past tolerance while
+                # staying FINITE — the silent-corruption verdict the NaN
+                # rows above provably cannot raise.  Rides the same
+                # rollback/quarantine machinery as a divergence.
+                bad_inv = memrefs.check(host[len(watch):], lanes)
+                if bad_inv:
+                    pending.clear()
+                    return _emit(
+                        "integrity_violation", step_p, source="invariant",
+                        members=sorted(bad_inv),
+                        invariants={str(m): v
+                                    for m, v in sorted(bad_inv.items())})
             if np.array_equal(lanes, valid):
                 # Probe-confirmed for EVERY active lane: the generation at
                 # (or newest below) this step is a protected rollback
@@ -964,6 +1051,13 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                 fail = _poll_probes(drain=True)
                 if fail is not None:
                     got = _handle_failure(fail, carry=cohort)
+                    if fail.kind == "integrity_violation":
+                        # Handled — restored from a lane-healthy
+                        # generation or quarantined; either way the
+                        # verdict is no longer live (statusd recovers).
+                        _emit("integrity_resolved", fail.step,
+                              members=fail.detail.get("members"),
+                              rolled_back=got is not None)
                     cohort, pos = got if got is not None else (
                         None, steps_done)
                     _refresh_mask()
@@ -973,6 +1067,13 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                 break
 
             _refresh_mask()
+            state_tap = _resilience._CHAOS_STATE_TAP
+            if state_tap is not None:
+                # Silent-corruption seam (igg.chaos.silent_corruption
+                # with member=): one lane perturbed finitely.
+                poisoned = state_tap(state, pos, _emit, steps_per_call)
+                if poisoned is not state:
+                    state = pk.put_state(poisoned)
             if chaos is not None:
                 poisoned = chaos.apply(state, pos, _emit,
                                        span=steps_per_call)
@@ -1002,6 +1103,10 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                 fail = _poll_probes()
             if fail is not None:
                 got = _handle_failure(fail, carry=cohort)
+                if fail.kind == "integrity_violation":
+                    _emit("integrity_resolved", fail.step,
+                          members=fail.detail.get("members"),
+                          rolled_back=got is not None)
                 if got is not None:
                     cohort, pos = got
                 else:

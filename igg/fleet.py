@@ -686,7 +686,7 @@ def _run_job(job: Job, jobdir: pathlib.Path, devs, resume_job: bool,
             # journal; a resume re-admits it (and may repack then).
             repack_count = None
         if res.preempted and repack_count is not None:
-            # Loop 3 (igg.heal): the preemption was the heal engine's
+            # Loop 4 (igg.heal): the preemption was the heal engine's
             # doing — the job measured below its cost-model expectation
             # and wrote its final generation on the way out.  Re-admit it
             # IMMEDIATELY at a different member packing, resuming

@@ -7,7 +7,7 @@ metrics — but every response was still "emit an event" or a fixed
 rung-drop.  This module closes the loops: a :class:`HealEngine`
 subscribes to the unified event bus (:func:`igg.telemetry.subscribe`)
 and drives the recovery machinery the earlier PRs already built, with
-three concrete loops:
+four concrete loops:
 
 1. **Stall / straggler → elastic re-tile.**  A ``collective_stall``
    verdict (the :class:`igg.comm.StallWatchdog` heartbeat), a
@@ -31,7 +31,20 @@ three concrete loops:
    (:func:`igg.perf.predict`), and a ``recalibrated`` event lands on the
    bus — the drift gauge re-anchors to measured reality.
 
-3. **Lagging fleet job → repack.**  A fleet job whose measured
+3. (round 19) **Silent data corruption → verified rollback +
+   fence-the-suspect re-tile.**  An ``integrity_violation`` verdict
+   (:mod:`igg.integrity` — an invariant drifted or a shadow
+   re-execution disagreed, with the suspect device attributed by its
+   per-rank partial sum) plans a **retile** whose fence targets the
+   attributed chip: the run loop has already rolled back onto a
+   DEEP-verified generation (``verify_checkpoint(deep=True)``), and
+   the re-tile removes the device that corrupted the arithmetic from
+   the serving set.  The same violation recurring at the same step
+   after a clean rollback is the deterministic-miscompile signature
+   and demotes the serving tier (the run loop's recurrence rung — no
+   heal budget burned).
+
+4. **Lagging fleet job → repack.**  A fleet job whose measured
    ``member_steps_per_s`` falls below ``throughput_tol`` × its
    cost-model expectation (``Job.expected_member_steps_per_s``, or the
    job's own healthy baseline) is preempted at the next dispatch
@@ -184,7 +197,7 @@ class HealEngine:
         self._windows: List[float] = []    # healthy-baseline ms/step
         self._baseline: Optional[float] = None
         self._attached = False
-        # Fleet job watch (loop 3): planned repacks carry the preemption
+        # Fleet job watch (loop 4): planned repacks carry the preemption
         # request count the engine's own request produced, so the
         # scheduler can tell a heal preemption from an operator SIGTERM
         # racing it.
@@ -242,7 +255,7 @@ class HealEngine:
             self.skipped.append({"action": action, **detail})
             self._skip_kinds.add(action)
 
-    # -- fleet job watch (loop 3) ------------------------------------------
+    # -- fleet job watch (loop 4) ------------------------------------------
     def watch_job(self, name: str,
                   expected_member_steps_per_s: Optional[float]) -> None:
         """Arm lag detection for one fleet job: nested ``step_stats``
@@ -295,6 +308,23 @@ class HealEngine:
             if rec.payload.get("run") == self.run:
                 self._signal(("stall",), "retile", sustain=1,
                              reason="collective_stall", step=rec.step)
+        elif kind == "integrity_violation":
+            # Loop 3 (round 19): an ATTRIBUTED silent-data-corruption
+            # verdict (igg.integrity — finite-but-wrong state the NaN
+            # watchdog cannot see).  The run loop already rolls back to a
+            # deep-VERIFIED generation; the heal action fences the
+            # attributed suspect device and re-tiles over the survivors —
+            # a chip that silently corrupts arithmetic must not keep
+            # serving.  Hard verdict (debounced at the probe): acts on
+            # the first event.
+            if rec.payload.get("run") == self.run:
+                rank = rec.payload.get("rank")
+                self._signal(("integrity",), "retile", sustain=1,
+                             reason="integrity_violation", step=rec.step,
+                             suspects=([int(rank)] if rank is not None
+                                       else None),
+                             invariant=rec.payload.get("invariant"),
+                             field=rec.payload.get("field"))
         elif kind == "cost_model_drift":
             # Advisory signal: re-anchor ONCE per family (a prediction
             # cannot match two genuinely different measurement regimes,
@@ -330,7 +360,7 @@ class HealEngine:
         if not isinstance(ms, (int, float)) or ms <= 0:
             return
         run = p.get("run")
-        # Loop 3: a watched fleet job's nested ensemble windows.
+        # Loop 4: a watched fleet job's nested ensemble windows.
         if run == "ensemble" and self._job is not None:
             rate = p.get("member_steps_per_s", p.get("steps_per_s"))
             if not isinstance(rate, (int, float)):
@@ -449,7 +479,7 @@ class HealEngine:
             _telemetry.emit("heal_escalated", run=self.run, **plan)
         else:
             _telemetry.emit("heal_planned", run=self.run, **plan)
-        # Loop 3's action is delivered through the preemption flag: the
+        # Loop 4's action is delivered through the preemption flag: the
         # scheduler is blocked inside the job's run loop, and preempting
         # at the next dispatch boundary (final generation written — the
         # PR-6 path) is exactly "preempted at the next generation".
@@ -483,12 +513,26 @@ class HealEngine:
         :func:`igg.fleet.plan_dims`.  Returns
         ``(devices, dims, local)`` — the ``init_global_grid``
         arguments — or raises :class:`GridError` when no decomposition
-        fits the survivors."""
+        fits the survivors.  Integer suspects are SHARD RANKS (the
+        integrity layer's per-rank partial-sum attribution) and resolve
+        to the device holding that block on the live mesh."""
         import numpy as np
 
         from .fleet import plan_dims
 
         devs = list(grid.mesh.devices.flat)
+        if suspects is not None:
+            resolved = []
+            for s in suspects:
+                if isinstance(s, (int, np.integer)):
+                    try:
+                        resolved.append(
+                            grid.mesh.devices[grid.cart_coords(int(s))])
+                    except (ValueError, IndexError):
+                        continue   # a rank from a previous topology
+                else:
+                    resolved.append(s)
+            suspects = resolved or None
         if suspects is None:
             drop = max(1, int(self.policy.retile_drop))
             suspects = devs[-drop:] if len(devs) > 1 else []

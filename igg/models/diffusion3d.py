@@ -286,6 +286,19 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
         family="diffusion3d", verify=verify)
 
 
+# Numeric-integrity declaration (igg.integrity, round 19): under fully
+# periodic boundaries the conservative flux-divergence update preserves
+# the total temperature sum exactly (up to accumulation roundoff) — the
+# invariant the silent-data-corruption probes watch for state dicts
+# carrying this family's canonical "T" field.
+from igg import integrity as _integrity
+
+_integrity.register_invariants("diffusion3d", [
+    _integrity.Invariant("total_heat", ("T",), moment=1, kind="conserved",
+                         requires_periodic=True),
+])
+
+
 def run(nt: int, params: Params = Params(), dtype=np.float32,
         warmup: int = 1, n_inner: int = 1, use_pallas="auto",
         overlap: bool = False, pallas_interpret: bool = False,

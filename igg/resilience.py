@@ -161,6 +161,12 @@ class Event:
     the generation was committed by the async writer), 'checkpoint_failed'
     (a background write failed — one generation of ring depth lost),
     'nan_detected', 'divergence',
+    'integrity_violation' (a finite-but-wrong verdict from the
+    igg.integrity layer — an invariant drifted past tolerance or a
+    shadow re-execution disagreed; detail names the invariant/field,
+    drift, and the attributed suspect rank/device),
+    'integrity_resolved' (the violation's rollback landed on a verified
+    generation — the statusd readiness reason clears),
     'rollback', 'tier_degraded' (the recovery ladder demoted the kernel
     tier that served the failing dispatch — a recurrence at the same step
     is the signature of a deterministic kernel blowup; detail: tier,
@@ -224,6 +230,14 @@ def _make_probe():
 # becomes ready) is injectable deterministically.  Host-level (consulted
 # at poll time, never traced), so arming needs no cache clearing.
 _CHAOS_FETCH_TAP = None
+
+# Fault-injection seam (igg.chaos.silent_corruption): a state transform
+# applied at every dispatch boundary of the run loops — the host-level
+# stand-in for silent data corruption (an HBM bit-flip, a flaky chip's
+# finite-but-wrong answer) landing in live state between dispatches.
+# Host-level and one-shot inside the injector, so arming needs no cache
+# clearing and a rolled-back replay passes the same step clean.
+_CHAOS_STATE_TAP = None
 
 
 def _is_ready(x) -> bool:
@@ -425,6 +439,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                   serve=None,
                   comm=None,
                   heal=None,
+                  integrity=None,
                   chaos=None) -> RunResult:
     """Drive `state = step_fn(state)` for `n_steps` steps with a device-side
     NaN/Inf watchdog, a rolling checkpoint ring, rollback-and-retry, and
@@ -515,6 +530,24 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
       is a typed ``heal_*`` bus record.  With no fault present the
       engine costs the hot loop one deque check per iteration — zero
       host syncs (the PR-7 sentinel runs with it enabled).
+    - `integrity`: the numeric-integrity layer (:mod:`igg.integrity`) —
+      None (default: on only when ``IGG_INTEGRITY=1``), True
+      (env-config), an :class:`igg.integrity.IntegrityConfig`, or False
+      (off).  Family-declared invariant probes and shadow re-execution
+      spot checks are FUSED into the watchdog probe (one concatenated
+      vector, the same single async fetch per watch window — zero
+      additional host syncs; requires `watch_every` > 0), finite-but-
+      wrong state raises ``integrity_violation`` with per-rank device
+      attribution, checkpoint generations are stamped with the
+      invariants' references, and the rollback/resume scans PREFER the
+      newest DEEP-verified generation
+      (``igg.verify_checkpoint(deep=True)``) — closing the
+      finite-but-poisoned window `check_finite` cannot.  An
+      ``integrity_violation`` recurring at the same step after a clean
+      rollback demotes the serving tier (the deterministic-miscompile
+      rung), and with `heal=` attached the violation additionally plans
+      a fence-the-suspect-device elastic re-tile
+      (docs/resilience.md, "Silent data corruption").
     - `chaos`: an :class:`igg.chaos.ChaosPlan` for deterministic fault
       injection (CI/testing only).
 
@@ -634,6 +667,20 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             "be coordinated mid-run across controller processes); "
             "disabled for this run.", stacklevel=2)
         heal_eng = None
+    # Numeric-integrity layer (igg.integrity): invariant probes + shadow
+    # re-execution checks fused into the watchdog probe, deep-verified
+    # rollback.  Config coercion + validation here (before the statusd
+    # server binds); the Monitor itself is built in the pre-loop try
+    # below, after a resume has settled the state it validates against.
+    from . import integrity as _integrity
+
+    int_cfg = _integrity.as_config(integrity)
+    if int_cfg is not None and not (watch and watch_every):
+        raise GridError(
+            "run_resilient: the integrity= probes ride the watch cadence; "
+            "set watch_every > 0 (with watched fields).")
+    deep_pref = int_cfg is not None and int_cfg.resolved_deep()
+    mon: Optional[_integrity.Monitor] = None
     comm_mon = None
     if comm is not None:
         if not (hasattr(comm, "maybe_dispatch") and hasattr(comm, "poll")):
@@ -686,8 +733,19 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     resumed_step = None
     try:
         if resume and cdir is not None:
-            found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True,
-                                           distributed=dist_verify)
+            found = None
+            if deep_pref:
+                # Verified resume: prefer the newest DEEP-verified
+                # generation (recomputed integrity stamps + invariant
+                # references); unstamped/poisoned generations fall through
+                # to the plain finite scan below.
+                found = ckpt.latest_checkpoint(
+                    cdir, prefix, check_finite=True,
+                    distributed=dist_verify, deep=True)
+            if found is None:
+                found = ckpt.latest_checkpoint(
+                    cdir, prefix, check_finite=True,
+                    distributed=dist_verify)
             if found is not None:
                 # redistribute=True makes the resume ELASTIC: a generation
                 # written under a different dims/device count is re-tiled
@@ -709,13 +767,21 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                         f"the checkpoint was written under.")
                 _emit("resume", steps_done, path=str(found))
         probe = _make_probe() if (watch and watch_every) else None
+        if int_cfg is not None:
+            # Built AFTER the resume scan: the monitor validates its
+            # invariants against (and snapshots) the state actually run.
+            mon = _integrity.Monitor(int_cfg, state, watch, watch_every,
+                                     steps_per_call)
     except BaseException as e:
         # A pre-loop failure must not leak the run-owned session into the
-        # process-global sink list (nor the heal engine's subscription).
+        # process-global sink list (nor the heal engine's subscription,
+        # nor the integrity monitor's checkpoint-stamp context).
         paths = _telemetry._auto_dump(f"run_resilient: "
                                       f"{type(e).__name__}: {e}")
         if isinstance(e, ResilienceError):
             e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
+        if mon is not None:
+            mon.close()
         if heal_eng is not None:
             heal_eng.detach()
         if srv_owns:
@@ -847,7 +913,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         failure event of the first non-finite probe, else None."""
         nonlocal last_good
         while pending:
-            step_p, counts = pending[0]
+            step_p, counts, tag = pending[0]
             if (not drain and len(pending) <= max_pending_probes
                     and (deterministic_only or not _is_ready(counts))):
                 return None
@@ -855,13 +921,30 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             host = np.asarray(counts)
             if stall is not None:
                 stall.fetched(("probe", step_p), step_p)
-            bad = {n: int(c) for n, c in zip(watch, host) if c != 0}
+            viol = None
+            if mon is not None:
+                nf, viol = mon.decode(host, tag, step_p)
+                bad = {n: int(c) for n, c in zip(watch, nf) if c != 0}
+            else:
+                bad = {n: int(c) for n, c in zip(watch, host) if c != 0}
             if bad:
                 # Younger pending probes are post-failure noise.
                 pending.clear()
                 if stall is not None:
                     stall.clear()
                 return _emit("nan_detected", step_p, counts=bad)
+            if viol is not None:
+                # Finite-but-wrong state (an invariant drifted past its
+                # tolerance, or a shadow re-execution disagreed): the
+                # silent-data-corruption verdict — per-rank partials
+                # attribute the suspect device, the rollback below
+                # prefers a DEEP-verified generation, and an attached
+                # heal engine plans fence + elastic re-tile off this
+                # bus record.
+                pending.clear()
+                if stall is not None:
+                    stall.clear()
+                return _emit("integrity_violation", step_p, **viol)
             last_good = max(last_good, step_p)
             # Step stats piggyback on THIS fetch (igg.telemetry): the
             # probe was already materialized for the verdict, so the rate
@@ -872,9 +955,15 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
     def _dispatch_probe() -> None:
         """One watchdog probe dispatch, registered with the stall
         heartbeat (the in-flight record a hung collective is reported
-        against)."""
-        counts = probe(*[state[n] for n in watch])
-        pending.append((steps_done, counts))
+        against).  With integrity enabled the monitor's FUSED probe
+        serves instead — non-finite counts, invariant partials, and (on
+        the check cadence) the shadow re-execution diffs in ONE vector,
+        so the loop still fetches exactly one array per window."""
+        if mon is not None:
+            counts, tag = mon.dispatch(state, steps_done, step_fn)
+        else:
+            counts, tag = probe(*[state[n] for n in watch]), None
+        pending.append((steps_done, counts, tag))
         if stall is not None:
             stall.watch(("probe", steps_done), steps_done,
                         "watchdog probe (psum over mesh axes)", counts)
@@ -907,6 +996,12 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 # the per-run view's step-anchored copy only.
                 _emit("tier_degraded", ev.step, _bus=False, tier=tname,
                       reason="nan_recurrence")
+            if demoted and mon is not None:
+                # The demoted tier's physics was wrong, so integrity
+                # references anchored on its trajectory would flag the
+                # now-correct replay forever — re-anchor on the healthy
+                # rung's values.
+                mon.reset_reference()
         last_fail = (ev.kind, ev.step)
         if not demoted:
             retries += 1
@@ -939,9 +1034,21 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         # on a multi-controller run every process executes the same
         # collectives in the same order even if their directory listings
         # transiently diverge (NFS attribute caches).
-        found = ckpt.latest_checkpoint(
-            cdir, prefix, check_finite=True, max_step=ev.step,
-            distributed=jax.process_count() > 1)
+        found = None
+        if mon is not None and mon.deep_verify:
+            # VERIFIED-generation rollback: a finite-but-POISONED
+            # generation (saved from silently-corrupted state) passes
+            # check_finite but fails the deep stamp's invariant
+            # references — prefer the newest generation that deep-
+            # verifies, falling back to the plain scan only when none is
+            # stamped (mixed pre-/post-round-19 rings stay recoverable).
+            found = ckpt.latest_checkpoint(
+                cdir, prefix, check_finite=True, max_step=ev.step,
+                distributed=jax.process_count() > 1, deep=True)
+        if found is None:
+            found = ckpt.latest_checkpoint(
+                cdir, prefix, check_finite=True, max_step=ev.step,
+                distributed=jax.process_count() > 1)
         target = ((ckpt.checkpoint_step(found), found)
                   if found is not None else None)
         if target is None:
@@ -972,6 +1079,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     ckpt.remove_generation(p)
         _emit("rollback", steps_done, from_step=ev.step,
               attempt=retries, path=str(target[1]))
+        if mon is not None:
+            mon.on_rollback(state, steps_done)
+            if ev.kind == "integrity_violation":
+                # The corruption verdict is no longer live: the state was
+                # replaced from a verified generation.  statusd readiness
+                # (pinned reason "integrity_violation") recovers on this
+                # record.
+                _emit("integrity_resolved", steps_done, from_step=ev.step,
+                      path=str(target[1]),
+                      deep_verified=bool(mon.deep_verify))
         if recovery_policy is not None:
             out = recovery_policy(retries, state, ev)
             if isinstance(out, tuple):
@@ -1031,7 +1148,16 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 periodz=periods[2], overlapx=overlaps[0],
                 overlapy=overlaps[1], overlapz=overlaps[2],
                 devices=devs, quiet=True)
-            found = ckpt.latest_checkpoint(cdir, prefix, check_finite=True)
+            found = None
+            if mon is not None and mon.deep_verify:
+                # The retile resume honors the verified-generation
+                # contract too: an integrity-triggered re-tile must never
+                # resume from the very generation the violation poisoned.
+                found = ckpt.latest_checkpoint(cdir, prefix,
+                                               check_finite=True, deep=True)
+            if found is None:
+                found = ckpt.latest_checkpoint(cdir, prefix,
+                                               check_finite=True)
             if found is None:
                 raise ResilienceError(
                     f"igg.heal: elastic re-tile at step {steps_done} found "
@@ -1048,6 +1174,11 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         synced.add(steps_done)
         last_good = steps_done
         last_ckpt, last_ckpt_step = found, steps_done
+        if mon is not None:
+            # The probe re-traces on the new grid epoch; per-rank
+            # reference partials re-anchor at the next clean fetch (the
+            # global references survive — same field, fewer devices).
+            mon.on_retile(state, steps_done)
         stats = _telemetry.StepStats(
             "resilient",
             perf=(_perf.sample_context(state[watch[0]])
@@ -1151,6 +1282,11 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         # the identical rewrite).
         if checkpoint_every and steps_done != resumed_step:
             _save_gen(steps_done)
+        if mon is not None:
+            # Shadow spot checks: snapshot the entry state (device-
+            # resident references, no fetch) so the FIRST watch window is
+            # re-executable.
+            mon.arm_entry(state, steps_done)
 
         final_probe_done = False
         donation_probe = bool(use_async)   # probe until donation observed
@@ -1170,6 +1306,14 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     if _preempt.is_set():
                         preempted = True
                         break
+                state_tap = _CHAOS_STATE_TAP
+                if state_tap is not None:
+                    # Silent-corruption seam (igg.chaos.silent_corruption):
+                    # a host-level, one-shot finite perturbation at the
+                    # dispatch boundary — the fault the NaN watchdog
+                    # provably cannot see.
+                    state = state_tap(state, steps_done, _emit,
+                                      steps_per_call)
                 # EVERY field is probed: a step may donate some fields but
                 # not the dict's first one (e.g. a pass-through
                 # coefficient), and missing the donation would cost a ring
@@ -1187,6 +1331,12 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                     donating = True
                     if writer is not None:
                         writer.note_donation()
+                    if mon is not None:
+                        # Shadow snapshots are held by reference too —
+                        # same hazard, same degradation (invariant probes
+                        # keep running; only the re-execution checks
+                        # stop).
+                        mon.note_donation()
                     import warnings
 
                     warnings.warn(
@@ -1290,6 +1440,8 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
             e.dump_paths.extend(p for p in paths if p not in e.dump_paths)
         raise
     finally:
+        if mon is not None:
+            mon.close()   # clears the checkpoint-stamp context
         if heal_eng is not None:
             heal_eng.detach()
         if comm_mon is not None:

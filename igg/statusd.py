@@ -33,6 +33,11 @@ missing live surface:
                          (budget exhausted, signal persisting)
   ``watchdog_fetch_lag`` the watchdog's fetch lag exceeds
                          ``IGG_STATUSD_MAX_FETCH_LAG`` steps
+  ``integrity_violation`` a live silent-data-corruption verdict
+                         (:mod:`igg.integrity`) — the served state is
+                         finite-but-wrong; recovers on the
+                         ``integrity_resolved`` record a verified
+                         rollback emits
   ====================== ==============================================
 
 - **`/status`** returns structured JSON: run progress and step rate
@@ -102,6 +107,7 @@ REASON_STALL = "collective_stall"
 REASON_ALL_QUARANTINED = "all_members_quarantined"
 REASON_ESCALATED = "heal_escalated"
 REASON_FETCH_LAG = "watchdog_fetch_lag"
+REASON_INTEGRITY = "integrity_violation"
 
 _HEAL_KINDS = ("heal_planned", "heal_retile", "heal_repack",
                "heal_suppressed", "heal_skipped", "heal_escalated",
@@ -149,6 +155,13 @@ class HealthState:
             self.heal: deque = deque(maxlen=64)
             self.checkpoint: Optional[dict] = None
             self.last_stall: Optional[dict] = None
+            # Integrity (round 19): the LIVE silent-data-corruption
+            # verdict (readiness 503 until a verified rollback resolves
+            # it), plus the resolved tail and counters for /status.
+            self.integrity_violation: Optional[dict] = None
+            self.integrity_resolved: Optional[dict] = None
+            self.integrity_total = 0
+            self.integrity_config: Optional[dict] = None
 
     # -- lifecycle ---------------------------------------------------------
     def attach(self) -> "HealthState":
@@ -212,8 +225,14 @@ class HealthState:
                                   "steps_done": 0, "finished": False}
                 # A fresh run resets the terminal verdicts of the last
                 # one: an escalation/quarantine wall belongs to the run
-                # that died, not to its successor.
+                # that died, not to its successor — and so does its
+                # integrity CONFIG (a non-integrity run on a shared
+                # server must not claim the previous run's SDC coverage;
+                # an integrity-enabled run re-emits integrity_config
+                # right after run_started).
                 self.escalated = None
+                self.integrity_violation = None
+                self.integrity_config = None
                 if run == "ensemble":
                     self.members_total = int(p.get("members") or 0)
                     self.members_quarantined = set()
@@ -246,6 +265,22 @@ class HealthState:
             with self._lock:
                 self.last_stall = {"step": rec.step, "wall": rec.wall,
                                    **rec.payload}
+            return
+        if kind == "integrity_violation":
+            with self._lock:
+                self.integrity_total += 1
+                self.integrity_violation = {"step": rec.step,
+                                            "wall": rec.wall, **rec.payload}
+            return
+        if kind == "integrity_resolved":
+            with self._lock:
+                self.integrity_violation = None
+                self.integrity_resolved = {"step": rec.step,
+                                           "wall": rec.wall, **rec.payload}
+            return
+        if kind == "integrity_config":
+            with self._lock:
+                self.integrity_config = {**rec.payload}
             return
         if kind in _HEAL_KINDS:
             with self._lock:
@@ -281,6 +316,19 @@ class HealthState:
                     "escalated_from": self.escalated.get("escalated_from"),
                     "signal_reason": self.escalated.get("signal_reason"),
                     "step": self.escalated.get("step")})
+            if self.integrity_violation is not None:
+                # A live silent-data-corruption verdict: the served state
+                # is finite-but-wrong until a verified rollback lands
+                # (integrity_resolved clears this — readiness RECOVERS).
+                v = self.integrity_violation
+                reasons.append({
+                    "reason": REASON_INTEGRITY,
+                    "source": v.get("source"),
+                    "invariant": v.get("invariant"),
+                    "field": v.get("field"),
+                    "rank": v.get("rank"),
+                    "device": v.get("device"),
+                    "step": v.get("step")})
             if self.max_fetch_lag > 0:
                 for run, info in self.runs.items():
                     lag = info.get("fetch_lag_steps")
@@ -306,6 +354,15 @@ class HealthState:
                                if self.checkpoint else None),
                 "last_stall": (dict(self.last_stall)
                                if self.last_stall else None),
+                "integrity": {
+                    "violation": (dict(self.integrity_violation)
+                                  if self.integrity_violation else None),
+                    "resolved": (dict(self.integrity_resolved)
+                                 if self.integrity_resolved else None),
+                    "violations_total": self.integrity_total,
+                    "config": (dict(self.integrity_config)
+                               if self.integrity_config else None),
+                },
             }
 
 

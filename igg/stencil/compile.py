@@ -63,6 +63,15 @@ def _register_family(spec: StencilSpec, analysis, cf: Dict) -> None:
     perf.register_family(spec.name, accesses=analysis.accesses,
                          steps=steps if spec.init is not None else None)
 
+    if spec.invariants:
+        # The igg.integrity hook (round 19): spec-declared conserved/
+        # bounded quantities join the silent-data-corruption probes —
+        # same registry the built-in families use, keyed by the spec's
+        # canonical field names.
+        from .. import integrity
+
+        integrity.register_invariants(spec.name, spec.invariants)
+
     if spec.init is not None:
         import numpy as np
 

@@ -293,12 +293,24 @@ class StencilSpec:
     def __init__(self, name: str, *, fields: Sequence[Field],
                  updates: Sequence[Update],
                  params: Sequence[Param] = (),
-                 bc: Sequence[str] = None, init=None):
+                 bc: Sequence[str] = None, init=None,
+                 invariants: Sequence = ()):
         self.name = str(name)
         self.fields = list(fields)
         self.updates = list(updates)
         self.params = list(params)
         self.init = init
+        # Numeric-integrity declarations (igg.integrity.Invariant): the
+        # spec's conserved/bounded quantities, registered next to the
+        # perf/autotune hooks at compile time so spec-defined physics
+        # participates in the silent-data-corruption probes.
+        self.invariants = tuple(invariants)
+        for inv in self.invariants:
+            if not {f for f in inv.fields} <= {f.name for f in fields}:
+                raise GridError(
+                    f"igg.stencil: spec {name!r} invariant {inv.name!r} "
+                    f"names fields {list(inv.fields)} not all declared "
+                    f"({[f.name for f in fields]}).")
         if not self.fields:
             raise GridError("igg.stencil: a spec needs at least one Field.")
         nd = self.fields[0].ndim

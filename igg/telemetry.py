@@ -397,6 +397,14 @@ _BUILTIN_HELP: Dict[str, str] = {
                                "exposes no allocator stats).",
     "igg_statusd_requests_total": "HTTP requests served by igg.statusd, "
                                   "by route.",
+    "igg_integrity_checks_total": "Clean integrity verdicts decoded from "
+                                  "fetched watchdog probes "
+                                  "(igg.integrity).",
+    "igg_integrity_shadow_checks_total": "Shadow re-execution comparisons "
+                                         "completed (igg.integrity).",
+    "igg_integrity_violations_total": "Silent-data-corruption verdicts "
+                                      "raised (invariant drift or shadow "
+                                      "mismatch; igg.integrity).",
 }
 
 

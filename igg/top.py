@@ -202,6 +202,7 @@ def build_from_dir(directory, n: int = _DEFAULT_EVENTS
         "quarantine": {},
         "members": view["members"],
         "heal": view["heal"][-16:],
+        "integrity": view.get("integrity"),
         "checkpoint": view["checkpoint"],
         "fleet": None,
         "hbm": hbm,
@@ -349,6 +350,25 @@ def render(status: dict, events: List[dict],
         last = heal[-1]
         lines.append(f"heal: {len(heal)} action record(s), last "
                      f"{last.get('kind')} @ step {last.get('step')}")
+    integ = status.get("integrity") or {}
+    viol = integ.get("violation")
+    if viol:
+        what = viol.get("invariant") or viol.get("field") or "?"
+        who = viol.get("device") or (f"rank {viol.get('rank')}"
+                                     if viol.get("rank") is not None
+                                     else "unattributed")
+        lines.append(f"integrity: VIOLATION LIVE ({viol.get('source')} "
+                     f"{what}, suspect {who}) @ step {viol.get('step')}")
+    elif integ.get("violations_total"):
+        res = integ.get("resolved") or {}
+        lines.append(f"integrity: {integ['violations_total']} "
+                     f"violation(s), last resolved @ step "
+                     f"{res.get('step')}")
+    elif integ.get("config"):
+        cfg = integ["config"]
+        inv_names = ",".join(cfg.get("invariants") or [])
+        lines.append(f"integrity: clean (invariants {inv_names or '-'}, "
+                     f"check_every {cfg.get('check_every')})")
 
     lines.append("-" * 72)
     lines.append(f"last {min(n_events, len(events))} event(s):")

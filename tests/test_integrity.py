@@ -1,0 +1,668 @@
+"""The numeric-integrity layer (igg/integrity.py) and its round-19
+satellites: silent-data-corruption defense end to end — invariant
+probes fused into the watchdog probe (finite-but-wrong state the NaN
+watchdog provably cannot see, detected within one watch window with
+per-rank device attribution), shadow re-execution spot checks for
+corruption with no declared invariant, verified-generation rollback
+(`verify_checkpoint(deep=True)` refusing poisoned-but-finite
+generations the structural scan serves), the heal loop's
+fence-the-suspect re-tile, recurrence demotion of a finitely-
+miscompiling tier, the chaos injectors (`silent_corruption`,
+`poison_checkpoint`), deep-verify coverage across formats (flat npz,
+sharded dirs, bf16, elastic restore, mixed stamped/unstamped rings,
+pre-round-19 backward compat), the per-member ensemble rows, the
+registry hook, the statusd readiness reason, and the env knobs."""
+
+import json
+
+import numpy as np
+import pytest
+
+import igg
+from igg import chaos
+from igg import checkpoint as ck
+from igg import integrity as integ
+from igg import telemetry as tel
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Metrics, the flight ring, and the perf ledger are process-global;
+    isolate every test (the test_heal fixture's pattern).  The chaos
+    state tap is module-global too — a failed test must not leak an
+    armed injector."""
+    tel.reset_metrics()
+    tel._ring().clear()
+    igg.perf.reset()
+    yield
+    from igg import resilience as res_mod
+
+    res_mod._CHAOS_STATE_TAP = None
+    for s in list(tel._SESSIONS):
+        s.detach()
+    with tel._lock:
+        tel._SUBSCRIBERS.clear()
+    tel.reset_metrics()
+    igg.perf.reset()
+    igg.degrade.reset()
+
+
+def _grid(n=6, **kw):
+    args = dict(periodx=1, periody=1, periodz=1, quiet=True)
+    args.update(kw)
+    igg.init_global_grid(n, n, n, **args)
+
+
+def _make_step():
+    from igg.ops import interior_add
+
+    @igg.sharded
+    def step(T):
+        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+               - 6.0 * T[1:-1, 1:-1, 1:-1])
+        return igg.update_halo_local(interior_add(T, 0.1 * lap))
+
+    return lambda st: {"T": step(st["T"])}
+
+
+def _init_state(n=6, seed=3):
+    rng = np.random.default_rng(seed)
+    T = igg.from_local_blocks(lambda c, ls: rng.standard_normal(ls),
+                              (n, n, n))
+    return {"T": igg.update_halo(T)}
+
+
+def _heat_cfg(**kw):
+    kw.setdefault("check_every", 0)
+    return integ.IntegrityConfig(
+        invariants=[integ.Invariant("total_heat", ("T",), moment=1,
+                                    kind="conserved")], **kw)
+
+
+def _reference(nt, n=6):
+    step_fn = _make_step()
+    st = _init_state(n)
+    for _ in range(nt):
+        st = step_fn(st)
+    return np.asarray(st["T"])
+
+
+# ---------------------------------------------------------------------------
+# (i) invariant probes: detection, attribution, verified rollback
+# ---------------------------------------------------------------------------
+
+def test_invariant_detects_finite_corruption_nan_watchdog_silent(tmp_path):
+    """The headline contract: a FINITE perturbation (the NaN watchdog
+    provably silent) is detected by the conserved-sum probe within one
+    watch window, attributed to the injected rank's device by the
+    per-rank partials, rolled back, and the run finishes bit-exact."""
+    _grid()
+    ref = _reference(60)
+    with chaos.silent_corruption("T", step=27, magnitude=25.0, rank=3):
+        res = igg.run_resilient(_make_step(), _init_state(), 60,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=10,
+                                integrity=_heat_cfg(),
+                                install_sigterm=False)
+    kinds = [e.kind for e in res.events]
+    assert "nan_detected" not in kinds
+    viol = next(e for e in res.events if e.kind == "integrity_violation")
+    assert viol.step == 30                       # next watch boundary
+    assert viol.detail["source"] == "invariant"
+    assert viol.detail["invariant"] == "total_heat"
+    assert viol.detail["rank"] == 3
+    assert viol.detail["partials"][3] == max(viol.detail["partials"])
+    rb = next(e for e in res.events if e.kind == "rollback")
+    assert rb.step < viol.step
+    assert kinds.index("rollback") < kinds.index("integrity_resolved")
+    assert res.retries == 1
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_rollback_skips_poisoned_generation_via_deep_verify(tmp_path):
+    """The finite-but-poisoned window: a cadence generation written
+    BETWEEN the corruption and its detection passes check_finite but
+    fails deep verification (its invariant drifted against the stamped
+    reference) — the rollback scan must land on the older verified
+    generation, never the poisoned one."""
+    _grid()
+    ref = _reference(60)
+    # checkpoint_every=5 == watch_every guarantees a generation at the
+    # corrupted-but-undetected step 25 (injection at 23, detection at
+    # the step-25 probe, cadence write at 25 submitted before the fetch).
+    with chaos.silent_corruption("T", step=23, magnitude=25.0, rank=1):
+        res = igg.run_resilient(_make_step(), _init_state(), 60,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=5,
+                                integrity=_heat_cfg(),
+                                max_pending_probes=8,
+                                install_sigterm=False)
+    viol = next(e for e in res.events if e.kind == "integrity_violation")
+    rb = next(e for e in res.events if e.kind == "rollback")
+    assert rb.step < viol.step <= 30
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_poisoned_generation_matrix_structural_serves_deep_refuses(
+        tmp_path):
+    """The satellite resilience-matrix proof, offline: poison_checkpoint
+    writes finite corruption CONSISTENTLY through the CRC layer on both
+    formats — the non-deep scan serves the poisoned generation, the deep
+    scan skips it (and pre-poison generations still deep-verify)."""
+    _grid()
+    st = _init_state()
+    igg.save_checkpoint_sharded(tmp_path / "ckpt_000000010", **st)
+    igg.save_checkpoint_sharded(tmp_path / "ckpt_000000020", **st)
+    igg.save_checkpoint(tmp_path / "ckpt_000000030.npz", **st)
+    chaos.poison_checkpoint(tmp_path / "ckpt_000000020", magnitude=5.0,
+                            shard=2)
+    chaos.poison_checkpoint(tmp_path / "ckpt_000000030.npz", magnitude=5.0)
+    # Structural + finite verification passes the poisoned artifacts...
+    assert ck.verify_checkpoint(tmp_path / "ckpt_000000020",
+                                check_finite=True)
+    assert ck.verify_checkpoint(tmp_path / "ckpt_000000030.npz",
+                                check_finite=True)
+    # ...and the corrupted values really did land (the CRC layer was
+    # rewritten, not bypassed).
+    loaded = igg.load_checkpoint(tmp_path / "ckpt_000000020")
+    assert not np.array_equal(np.asarray(loaded["T"]), np.asarray(st["T"]))
+    # Deep verification refuses exactly the poisoned ones.
+    assert not ck.verify_checkpoint(tmp_path / "ckpt_000000020", deep=True)
+    assert not ck.verify_checkpoint(tmp_path / "ckpt_000000030.npz",
+                                    deep=True)
+    assert ck.verify_checkpoint(tmp_path / "ckpt_000000010", deep=True)
+    assert ck.latest_checkpoint(tmp_path, "ckpt", check_finite=True) \
+        == tmp_path / "ckpt_000000030.npz"
+    assert ck.latest_checkpoint(tmp_path, "ckpt", check_finite=True,
+                                deep=True) == tmp_path / "ckpt_000000010"
+
+
+def test_shadow_check_catches_corruption_with_no_invariant(tmp_path):
+    """Mechanism 2: with NO declared invariant, the shadow re-execution
+    spot check (window re-dispatched from the device-resident entry
+    snapshot, |state − truth| compared on device) catches the silent
+    corruption — including one struck inside the very first window."""
+    _grid()
+    ref = _reference(60)
+    cfg = integ.IntegrityConfig(invariants=[], check_every=1)
+    with chaos.silent_corruption("T", step=2, magnitude=10.0, rank=5):
+        res = igg.run_resilient(_make_step(), _init_state(), 60,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=10, integrity=cfg,
+                                install_sigterm=False)
+    viol = next(e for e in res.events if e.kind == "integrity_violation")
+    assert viol.detail["source"] == "shadow"
+    assert viol.step == 5 and viol.detail["rank"] == 5
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_shadow_amortization_cadence(tmp_path):
+    """check_every=N shadows every N-th window only (the 1/check_every
+    cost contract): the monitor's shadow counter proves the cadence."""
+    _grid()
+    cfg = _heat_cfg(check_every=3)
+    captured = {}
+    orig = integ.Monitor.dispatch
+
+    def spy(self, *a, **kw):
+        captured["mon"] = self
+        return orig(self, *a, **kw)
+
+    integ.Monitor.dispatch = spy
+    try:
+        igg.run_resilient(_make_step(), _init_state(), 60, watch_every=5,
+                          integrity=cfg, install_sigterm=False)
+    finally:
+        integ.Monitor.dispatch = orig
+    mon = captured["mon"]
+    # 12 windows; snapshots at windows 0 (entry), 3, 6, 9 -> 4 shadows.
+    assert mon.shadow_checks == 4
+    assert mon.checks == 12
+
+
+def test_heal_fences_attributed_device_and_retiles_bit_exact(tmp_path):
+    """The closed loop: an attributed violation plans rollback-to-
+    verified plus a fence-the-SUSPECT-device re-tile — the chip named by
+    the per-rank partials leaves the serving set, and the healed run's
+    de-duplicated interior is bitwise the uninterrupted reference."""
+    from igg import heal as iheal
+
+    nt = 60
+    _grid()
+    dims0 = igg.get_global_grid().dims
+    step_fn = _make_step()
+    st = _init_state()
+    for _ in range(nt):
+        st = step_fn(st)
+    ref = igg.gather_interior(st["T"])
+    igg.finalize_global_grid()
+
+    _grid()
+    eng = iheal.HealEngine(iheal.HealPolicy(cooldown_s=0.0),
+                           run="resilient")
+    with chaos.silent_corruption("T", step=27, magnitude=25.0, rank=3):
+        res = igg.run_resilient(_make_step(), _init_state(), nt,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=10,
+                                integrity=_heat_cfg(), heal=eng,
+                                install_sigterm=False)
+    viol = next(e for e in res.events if e.kind == "integrity_violation")
+    retile = next(e for e in res.events if e.kind == "heal_retile")
+    assert retile.detail["reason"] == "integrity_violation"
+    g2 = igg.get_global_grid()
+    assert g2.dims != dims0
+    live = [str(d) for d in g2.mesh.devices.flat]
+    assert viol.detail["device"] not in live
+    assert np.array_equal(igg.gather_interior(res.state["T"]), ref)
+
+
+def test_recurrent_violation_demotes_finitely_miscompiling_tier(tmp_path):
+    """The PR-5 deterministic-miscompile signature, generalized: a
+    kernel tier corrupted by a FINITE magnitude produces wrong physics
+    the NaN watchdog never sees; the shadow check against the declared
+    TRUTH tier raises the same violation at the same step after a
+    bit-exact rollback, and the recurrence rung demotes the serving
+    tier — the truth rung finishes the run bit-exactly with no retry
+    burned on the recurrence (and the demotion re-anchors the integrity
+    references, so the healthy replay is never flagged against the
+    miscompiled trajectory)."""
+    from igg.models import diffusion3d as d3
+
+    nv = 8
+    igg.init_global_grid(nv, nv, 128, dimx=1, dimy=1, dimz=1, periodx=1,
+                         periody=1, periodz=1, quiet=True)
+    params = d3.Params()
+    T0, Cp = d3.init_fields(params, dtype=np.float32)
+
+    def make_state():
+        return {"T": T0, "Cp": Cp}
+
+    truth = d3.make_step(params, donate=False, use_pallas=False)
+
+    def truth_fn(s):
+        return {"T": truth(s["T"], s["Cp"]), "Cp": s["Cp"]}
+
+    st = make_state()
+    for _ in range(20):
+        st = truth_fn(st)
+    ref = np.asarray(st["T"])
+
+    igg.degrade.reset()
+    cfg = integ.IntegrityConfig(invariants=[], check_every=1,
+                                truth_step_fn=truth_fn)
+    with chaos.kernel_corrupt("diffusion3d.mosaic", magnitude=1e4):
+        step = d3.make_step(params, donate=False, pallas_interpret=True)
+        step_fn = lambda s: {"T": step(s["T"], s["Cp"]), "Cp": s["Cp"]}
+        res = igg.run_resilient(step_fn, make_state(), 20,
+                                watch_every=5, checkpoint_dir=tmp_path,
+                                checkpoint_every=5, integrity=cfg,
+                                install_sigterm=False)
+    kinds = [e.kind for e in res.events]
+    assert "nan_detected" not in kinds
+    assert kinds.count("integrity_violation") >= 2
+    demo = next(e for e in res.events if e.kind == "tier_degraded")
+    assert demo.detail["tier"] == "diffusion3d.mosaic"
+    assert demo.detail["reason"] == "nan_recurrence"
+    assert igg.degrade.active().get("diffusion3d") == "diffusion3d.xla"
+    assert res.retries == 1            # the demotion burned no retry
+    assert np.array_equal(np.asarray(res.state["T"]), ref)
+
+
+def test_nan_counts_stay_field_aligned_with_nonfloat_watch(tmp_path):
+    """Monitor keeps the FULL watch list (non-float fields get a zero
+    count row, the plain-probe contract): a NaN verdict under integrity
+    must name the field that actually blew up, not a zipped-off
+    neighbor."""
+    import jax.numpy as jnp
+
+    _grid()
+    base = _make_step()
+    mask = igg.from_local_blocks(
+        lambda c, ls: np.ones(ls, dtype=np.int32), (6, 6, 6))
+
+    def step_fn(st):
+        return {"mask": st["mask"], **base({"T": st["T"]})}
+
+    st = {"T": _init_state()["T"], "mask": mask}
+    with pytest.raises(igg.ResilienceError) as ei:
+        igg.run_resilient(step_fn, st, 20, watch_every=5,
+                          watch_fields=["mask", "T"],
+                          integrity=_heat_cfg(),
+                          chaos=chaos.ChaosPlan(nan_at=[(7, "T")]),
+                          install_sigterm=False)
+    ev = next(e for e in ei.value.events if e.kind == "nan_detected")
+    assert list(ev.detail["counts"]) == ["T"], ev.detail
+
+
+def test_silent_corruption_composes_under_armed():
+    """armed() drives arm/disarm for the new injector like any other,
+    and a consumed injector re-arms on re-entry."""
+    from igg import resilience as res_mod
+
+    inj = chaos.silent_corruption("T", step=3, magnitude=1.0)
+    with chaos.armed(inj) as got:
+        assert got is inj
+        assert res_mod._CHAOS_STATE_TAP is not None
+    assert res_mod._CHAOS_STATE_TAP is None
+    inj._fired = True
+    inj.arm()
+    assert inj._fired is False        # arming re-arms the one-shot
+    inj.disarm()
+
+
+def test_config_validation_and_knob_registration():
+    _grid()
+    with pytest.raises(igg.GridError, match="watch cadence"):
+        igg.run_resilient(_make_step(), _init_state(), 10, watch_every=0,
+                          integrity=_heat_cfg(), install_sigterm=False)
+    with pytest.raises(igg.GridError, match="not in"):
+        igg.run_resilient(
+            _make_step(), _init_state(), 10, watch_every=5,
+            integrity=integ.IntegrityConfig(invariants=[
+                integ.Invariant("x", ("missing",))]),
+            install_sigterm=False)
+    with pytest.raises(igg.GridError, match="integrity="):
+        integ.as_config("yes")
+    with pytest.raises(igg.GridError, match="moment"):
+        integ.Invariant("bad", ("T",), moment=3)
+    from igg import _env
+
+    for knob in ("IGG_INTEGRITY", "IGG_INTEGRITY_CHECK_EVERY",
+                 "IGG_INTEGRITY_TOL", "IGG_INTEGRITY_DEEP_VERIFY"):
+        assert knob in _env._KNOWN
+
+
+def test_env_knob_drives_default(tmp_path, monkeypatch):
+    """integrity=None is IGG_INTEGRITY-driven (the telemetry= pattern);
+    False wins over the env knob."""
+    _grid()
+    monkeypatch.setenv("IGG_INTEGRITY", "1")
+    monkeypatch.setenv("IGG_INTEGRITY_CHECK_EVERY", "0")
+    res = igg.run_resilient(_make_step(), _init_state(), 10, watch_every=5,
+                            telemetry=tmp_path, install_sigterm=False)
+    recs = [json.loads(l) for l in
+            (tmp_path / "events_r0.jsonl").read_text().splitlines()]
+    assert any(r["kind"] == "integrity_config" for r in recs)
+    assert integ.as_config(False) is None
+
+
+# ---------------------------------------------------------------------------
+# (ii) deep verification across formats (satellite 6)
+# ---------------------------------------------------------------------------
+
+def test_deep_verify_flat_and_sharded_roundtrip(tmp_path):
+    _grid()
+    st = _init_state()
+    igg.save_checkpoint(tmp_path / "flat_000000001.npz", **st)
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000001", **st)
+    for p in (tmp_path / "flat_000000001.npz", tmp_path / "gen_000000001"):
+        assert ck.verify_checkpoint(p, check_finite=True, deep=True)
+    # Flat meta and sharded manifest stamp IDENTICAL dedup sums.
+    with np.load(tmp_path / "flat_000000001.npz") as z:
+        meta = json.loads(bytes(z["__igg_meta__"].tobytes()).decode())
+    man = json.loads(
+        (tmp_path / "gen_000000001" / "manifest.json").read_text())
+    # Equal to the last ulp: the flat path sums strided views of the
+    # stacked array, the sharded path contiguous fetched blocks — numpy
+    # pairwise summation may split the two differently.
+    assert np.allclose(meta["deep"]["sums"]["T"], man["deep"]["sums"]["T"],
+                       rtol=1e-12, atol=0.0)
+
+
+def test_deep_verify_bf16_fields(tmp_path):
+    import jax.numpy as jnp
+
+    _grid()
+    T = _init_state()["T"].astype(jnp.bfloat16)
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000001", T=T)
+    igg.save_checkpoint(tmp_path / "flat_000000001.npz", T=T)
+    assert ck.verify_checkpoint(tmp_path / "gen_000000001", deep=True)
+    assert ck.verify_checkpoint(tmp_path / "flat_000000001.npz", deep=True)
+    chaos.poison_checkpoint(tmp_path / "gen_000000001", magnitude=4.0,
+                            shard=1)
+    assert ck.verify_checkpoint(tmp_path / "gen_000000001",
+                                check_finite=True)
+    assert not ck.verify_checkpoint(tmp_path / "gen_000000001", deep=True)
+
+
+def test_deep_verified_generation_restores_elastically(tmp_path):
+    """redistribute=True restore of a deep-verified generation onto a
+    different decomposition is bit-exact — the deep stamps describe the
+    de-duplicated PHYSICS, which is decomposition-invariant."""
+    _grid()
+    st = _init_state()
+    stacked = np.asarray(st["T"])
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000001", **st)
+    assert ck.verify_checkpoint(tmp_path / "gen_000000001", deep=True)
+    interior_ref = igg.gather_interior(st["T"])
+    igg.finalize_global_grid()
+    igg.init_global_grid(10, 10, 6, dimx=1, dimy=1, dimz=2, periodx=1,
+                         periody=1, periodz=1, quiet=True)
+    loaded = igg.load_checkpoint(tmp_path / "gen_000000001",
+                                 redistribute=True)
+    assert np.array_equal(igg.gather_interior(loaded["T"]), interior_ref)
+    # And a generation re-saved under the NEW decomposition deep-verifies
+    # with the SAME dedup sums (different shard partials, same physics).
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000002", **loaded)
+    assert ck.verify_checkpoint(tmp_path / "gen_000000002", deep=True)
+    m1 = json.loads(
+        (tmp_path / "gen_000000001" / "manifest.json").read_text())
+    m2 = json.loads(
+        (tmp_path / "gen_000000002" / "manifest.json").read_text())
+    assert np.allclose(m1["deep"]["sums"]["T"], m2["deep"]["sums"]["T"],
+                       rtol=1e-12)
+    del stacked
+
+
+def _strip_deep(gen):
+    """Rewind a generation to its pre-round-19 shape: no deep stamps in
+    the manifest or shard metas (the backward-compat fixture)."""
+    import pathlib
+
+    gen = pathlib.Path(gen)
+    if gen.is_dir():
+        mp = gen / "manifest.json"
+        man = json.loads(mp.read_text())
+        man.pop("deep", None)
+        from igg.checkpoint import (_META_KEY, _shard_name, _summary_crc,
+                                    _write_atomic_text, _write_npz)
+
+        for name in list(man["shards"]):
+            sp = gen / name
+            with np.load(sp) as z:
+                smeta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+                arrays = {k: z[k] for k in z.files if k != _META_KEY}
+            smeta.pop("deep", None)
+            _write_npz(sp, {**arrays, _META_KEY: np.frombuffer(
+                json.dumps(smeta).encode(), dtype=np.uint8)})
+        _write_atomic_text(mp, json.dumps(man))
+        return
+    from igg.checkpoint import _META_KEY, _write_npz
+
+    with np.load(gen) as z:
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    meta.pop("deep", None)
+    _write_npz(gen, {**arrays, _META_KEY: np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)})
+
+
+def test_mixed_stamped_unstamped_ordering_and_backward_compat(tmp_path):
+    """Pre-round-19 generations (no deep stamp) load and scan unchanged;
+    a deep=True scan skips them (deep cannot vouch), so a 'prefer
+    deep' caller falls back to the newest stamped one — the mixed-ring
+    ordering contract."""
+    _grid()
+    st = _init_state()
+    igg.save_checkpoint_sharded(tmp_path / "ckpt_000000010", **st)
+    igg.save_checkpoint_sharded(tmp_path / "ckpt_000000020", **st)
+    igg.save_checkpoint(tmp_path / "ckpt_000000030.npz", **st)
+    _strip_deep(tmp_path / "ckpt_000000020")
+    _strip_deep(tmp_path / "ckpt_000000030.npz")
+    # Backward compat: unstamped artifacts verify structurally, load
+    # bit-exactly, and still win the PLAIN scan.
+    for p in ("ckpt_000000020", "ckpt_000000030.npz"):
+        assert ck.verify_checkpoint(tmp_path / p, check_finite=True)
+        loaded = igg.load_checkpoint(tmp_path / p)
+        assert np.array_equal(np.asarray(loaded["T"]), np.asarray(st["T"]))
+        assert not ck.verify_checkpoint(tmp_path / p, deep=True)
+    assert ck.latest_checkpoint(tmp_path, "ckpt", check_finite=True) \
+        == tmp_path / "ckpt_000000030.npz"
+    assert ck.latest_checkpoint(tmp_path, "ckpt", check_finite=True,
+                                deep=True) == tmp_path / "ckpt_000000010"
+    # A resume over the mixed ring under integrity (deep preference)
+    # lands on the stamped generation.
+    res = igg.run_resilient(_make_step(), _init_state(), 10, watch_every=5,
+                            checkpoint_dir=tmp_path, prefix="ckpt",
+                            checkpoint_every=0, resume=True,
+                            integrity=_heat_cfg(),
+                            install_sigterm=False)
+    resume = next(e for e in res.events if e.kind == "resume")
+    assert resume.step == 10
+    assert resume.detail["path"].endswith("ckpt_000000010")
+
+
+def test_open_boundary_owned_planes_in_deep_stamp(tmp_path):
+    """Open-boundary user-owned halo planes are de-duplicated global
+    cells: the deep stamp covers them (a perturbation there is caught),
+    and the stamp round-trips on mixed periodicity."""
+    _grid(periodx=0)
+    st = _init_state()
+    igg.save_checkpoint_sharded(tmp_path / "gen_000000001", **st)
+    assert ck.verify_checkpoint(tmp_path / "gen_000000001", deep=True)
+    chaos.poison_checkpoint(tmp_path / "gen_000000001", magnitude=3.0,
+                            shard=7)
+    assert not ck.verify_checkpoint(tmp_path / "gen_000000001", deep=True)
+
+
+# ---------------------------------------------------------------------------
+# (iii) the ensemble tier: per-member invariant rows
+# ---------------------------------------------------------------------------
+
+def test_ensemble_member_violation_isolated_and_bit_exact(tmp_path):
+    """A finite per-member corruption raises integrity_violation
+    attributed to the LANE; only that lane rolls back and replays —
+    every member finishes bit-exact vs an uninterrupted ensemble, no
+    quarantine, healthy lanes untouched."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from helpers import ensemble_member_step, ensemble_states
+
+    _grid()
+    clean = igg.run_ensemble(ensemble_member_step(), ensemble_states(4),
+                             40, watch_every=5, install_sigterm=False)
+    igg.finalize_global_grid()
+
+    _grid()
+    with chaos.silent_corruption("T", step=22, magnitude=30.0, member=2):
+        res = igg.run_ensemble(ensemble_member_step(), ensemble_states(4),
+                               40, watch_every=5, checkpoint_dir=tmp_path,
+                               checkpoint_every=10,
+                               integrity=_heat_cfg(),
+                               install_sigterm=False)
+    kinds = [e.kind for e in res.events]
+    assert "member_diverged" not in kinds       # the NaN rows stayed silent
+    viol = next(e for e in res.events if e.kind == "integrity_violation")
+    assert viol.detail["members"] == [2]
+    assert viol.detail["invariants"] == {"2": ["total_heat"]}
+    assert kinds.index("member_rollback") < kinds.index(
+        "integrity_resolved")
+    assert res.quarantined == [] and res.retries == {2: 1}
+    for m in range(4):
+        assert np.array_equal(np.asarray(res.state["T"][m]),
+                              np.asarray(clean.state["T"][m])), m
+
+
+# ---------------------------------------------------------------------------
+# (iv) the registry hook + statusd readiness
+# ---------------------------------------------------------------------------
+
+def test_registry_and_auto_match():
+    _grid()
+    grid = igg.get_global_grid()
+    # The built-in families registered at import.
+    from igg.models import diffusion3d, shallow_water, wave2d  # noqa: F401
+
+    fams = integ.registered_families()
+    assert {"diffusion3d", "shallow_water", "wave2d"} <= set(fams)
+    got = integ.match_invariants({"T", "Cp"}, grid)
+    assert [i.name for i in got] == ["total_heat"]
+    got = integ.match_invariants({"h", "hu", "hv"}, grid)
+    assert [i.name for i in got] == ["total_mass"]
+    # wave energy is a bounded invariant, periodicity-free.
+    got = integ.match_invariants({"P", "Vx", "Vy"}, grid)
+    assert [(i.name, i.kind) for i in got] == [("wave_energy", "bounded")]
+    igg.finalize_global_grid()
+    # Conserved invariants drop off open grids; bounded ones survive.
+    _grid(periodx=0)
+    grid = igg.get_global_grid()
+    assert integ.match_invariants({"T"}, grid) == ()
+    assert [i.name for i in integ.match_invariants({"P", "Vx", "Vy"},
+                                                   grid)] \
+        == ["wave_energy"]
+
+
+def test_stencil_spec_invariants_register_on_compile():
+    from igg.stencil import shallow_water_spec
+
+    spec = shallow_water_spec()
+    assert [i.name for i in spec.invariants] == ["total_mass"]
+    with pytest.raises(igg.GridError, match="not all declared"):
+        from igg.stencil import Field, Update
+        from igg.stencil.spec import StencilSpec
+
+        f = Field("a", stagger=(0, 0))
+        StencilSpec("bad", fields=[f], updates=[Update(f, f + 1.0)],
+                    invariants=(integ.Invariant("x", ("zz",)),))
+
+
+def test_statusd_readiness_pinned_reason_and_recovery():
+    """The pinned /healthz reason: a live integrity_violation flips
+    readiness false naming "integrity_violation"; the verified
+    rollback's integrity_resolved record recovers it.  /status carries
+    the integrity section."""
+    from igg.statusd import REASON_INTEGRITY, HealthState
+
+    assert REASON_INTEGRITY == "integrity_violation"
+    hs = HealthState(max_fetch_lag=0).attach()
+    try:
+        tel.emit("integrity_violation", step=30, run="resilient",
+                 source="invariant", invariant="total_heat", rank=3,
+                 device="cpu:3")
+        ready, reasons = hs.readiness()
+        assert ready is False
+        assert reasons[0]["reason"] == REASON_INTEGRITY
+        assert reasons[0]["rank"] == 3
+        view = hs.view()
+        assert view["integrity"]["violation"]["invariant"] == "total_heat"
+        assert view["integrity"]["violations_total"] == 1
+        tel.emit("integrity_resolved", step=20, run="resilient",
+                 from_step=30)
+        ready, reasons = hs.readiness()
+        assert ready is True and reasons == []
+        assert hs.view()["integrity"]["violation"] is None
+        assert hs.view()["integrity"]["resolved"]["step"] == 20
+    finally:
+        hs.detach()
+
+
+def test_top_renders_integrity_section():
+    from igg import top as itop
+
+    status = {"health": {"ready": False,
+                         "reasons": [{"reason": "integrity_violation"}]},
+              "runs": {}, "integrity": {
+                  "violation": {"source": "invariant",
+                                "invariant": "total_heat",
+                                "rank": 3, "device": "cpu:3", "step": 30},
+                  "violations_total": 1}}
+    frame = itop.render(status, [])
+    assert "NOT READY (integrity_violation)" in frame
+    assert "VIOLATION LIVE" in frame and "total_heat" in frame
+    status["integrity"] = {"violation": None, "violations_total": 2,
+                           "resolved": {"step": 20}}
+    frame = itop.render(status, [])
+    assert "2 violation(s), last resolved @ step 20" in frame

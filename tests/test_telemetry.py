@@ -364,11 +364,12 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
             fetches.append(type(x).__name__)
         return real_asarray(x, *a, **kw)
 
-    def run(telemetry, comm=None, heal=None, serve=False):
+    def run(telemetry, comm=None, heal=None, serve=False, integrity=False):
         fetches.clear()
         igg.run_resilient(step_fn, _init_state(), 20, watch_every=5,
                           telemetry=telemetry, comm=comm, heal=heal,
-                          serve=serve, install_sigterm=False)
+                          serve=serve, integrity=integrity,
+                          install_sigterm=False)
         return len(fetches)
 
     monkeypatch.setattr(res_mod, "np", type(np)("np_proxy"))
@@ -456,6 +457,21 @@ def test_telemetry_adds_zero_host_syncs(tmp_path, monkeypatch):
     body = urllib.request.urlopen(srv.url + "/status", timeout=2).read()
     assert _json.loads(body)["runs"]["resilient"]["finished"] is True
     srv.stop()
+    # Round 19: with the INTEGRITY layer enabled too — invariant probes
+    # AND shadow re-execution checks at every window (check_every=1).
+    # The invariant moment sums and per-rank partials are FUSED into the
+    # watchdog probe (one concatenated vector per window), and the
+    # shadow truth replay is pure extra dispatch work whose comparison
+    # rides the same vector — the device-array fetch counts are STILL
+    # identical.
+    from igg import integrity as iintegrity
+
+    cfg = iintegrity.IntegrityConfig(
+        invariants=[iintegrity.Invariant("probe_sum", ("T",), moment=1,
+                                         kind="conserved", tol=1.0)],
+        check_every=1)
+    with_integrity = run(telemetry=tmp_path / "session6", integrity=cfg)
+    assert with_integrity == bare
 
 
 # ---------------------------------------------------------------------------
