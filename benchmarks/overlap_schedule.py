@@ -124,72 +124,48 @@ def _lower(fn, global_shapes, grid, nfields_spec=None):
     return jax.jit(sm).lower(*args).compile().as_text()
 
 
+def _compile_hidden(family, n, topo):
+    """AOT-lower one family's hide_communication step from the shared
+    step-variant recipe (`igg.comm.model_step_variants` — the same
+    closures `overlap_study.py`, `weak_scaling.py`'s exposed-comm
+    columns, and the autotuner's exposed-comm confirmation use): the
+    recipe supplies the overlapped local step, the per-field stagger for
+    the AOT global shapes, and the family's grid requirements (Stokes'
+    radius-2 chain needs overlap-3 blocks)."""
+    import igg
+    from igg.comm import model_step_variants
+
+    mv = model_step_variants(family)
+    grid = _init_grid(n, topo, **mv["grid_kwargs"])
+    dims = grid.dims
+
+    def local(*fields):
+        return mv["local"](*fields, overlap=True)
+
+    shapes = [tuple(dims[d] * n + mv["stagger"][i][d] for d in range(3))
+              for i in range(mv["nf"] + mv["naux"])]
+    specs = tuple(igg.spec_for(3) for _ in range(mv["nf"]))
+    txt = _lower(local, shapes, grid,
+                 nfields_spec=specs if mv["nf"] > 1 else specs[0])
+    igg.finalize_global_grid()
+    return txt
+
+
 def compile_diffusion(n, topo):
     """hide_communication diffusion step (radius-1, single field +
     coefficient)."""
-    import igg
-    from igg.models import diffusion3d as d3
-
-    grid = _init_grid(n, topo)
-    dims = grid.dims
-    params = d3.Params()
-    dx, dy, dz = params.spacing()
-    kw = dict(dx=dx, dy=dy, dz=dz, dt=params.timestep(), lam=params.lam)
-
-    def local(T, Cp):
-        return d3.local_step(T, Cp, **kw, overlap=True)
-
-    g = tuple(d * n for d in dims)
-    txt = _lower(local, [g, g], grid,
-                 nfields_spec=igg.spec_for(3))
-    igg.finalize_global_grid()
-    return txt
+    return _compile_hidden("diffusion3d", n, topo)
 
 
 def compile_stokes(n, topo):
     """hide_communication Stokes pseudo-transient iteration (radius-2,
     4 exchanged fields + buoyancy aux) on an overlap-3 grid."""
-    import igg
-    from igg.models import stokes3d
-
-    grid = _init_grid(n, topo, overlapx=3, overlapy=3, overlapz=3)
-    dims = grid.dims
-    kw = stokes3d._pseudo_steps(stokes3d.Params())
-
-    def local(P, Vx, Vy, Vz, Rho):
-        return stokes3d.local_iteration(P, Vx, Vy, Vz, Rho, **kw,
-                                        overlap=True)
-
-    g = tuple(d * n for d in dims)
-    gx = (dims[0] * (n + 1), dims[1] * n, dims[2] * n)
-    gy = (dims[0] * n, dims[1] * (n + 1), dims[2] * n)
-    gz = (dims[0] * n, dims[1] * n, dims[2] * (n + 1))
-    specs = tuple(igg.spec_for(3) for _ in range(4))
-    txt = _lower(local, [g, gx, gy, gz, g], grid, nfields_spec=specs)
-    igg.finalize_global_grid()
-    return txt
+    return _compile_hidden("stokes3d", n, topo)
 
 
 def compile_hm3d(n, topo):
     """hide_communication HM3D coupled two-field step."""
-    import igg
-    from igg.models import hm3d
-
-    grid = _init_grid(n, topo)
-    dims = grid.dims
-    params = hm3d.Params()
-    dx, dy, dz = params.spacing()
-    kw = dict(dx=dx, dy=dy, dz=dz, dt=params.timestep(), phi0=params.phi0,
-              npow=params.npow, eta=params.eta)
-
-    def local(Pe, phi):
-        return hm3d.local_step(Pe, phi, **kw, overlap=True)
-
-    g = tuple(d * n for d in dims)
-    txt = _lower(local, [g, g], grid,
-                 nfields_spec=(igg.spec_for(3), igg.spec_for(3)))
-    igg.finalize_global_grid()
-    return txt
+    return _compile_hidden("hm3d", n, topo)
 
 
 def _compile_trapezoid_common(n, topo, periods, n_inner, bx):

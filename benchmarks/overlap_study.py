@@ -175,21 +175,20 @@ def study_decomposition_smoke(platform):
     not a performance claim — on a single chip or a shared-core CPU mesh
     there is no communication to hide (module docstring)."""
     import igg
-    from igg.ops import interior_add
+    from igg.comm import model_step_variants
 
     igg.init_global_grid(16, 16, 16, periodx=1, periody=1, periodz=1,
                          quiet=True)
     grid = igg.get_global_grid()
 
-    def compute(T):
-        lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
-               + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
-               + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
-               - 6.0 * T[1:-1, 1:-1, 1:-1])
-        return interior_add(T, 0.1 * lap)
-
-    T = igg.update_halo(igg.zeros((16, 16, 16)) + 1.0)
-    d = igg.comm.decompose(compute, (T,), radius=1, nt=3, n_inner=5)
+    # The shared step-variant recipe (igg.comm.model_step_variants):
+    # the same compute closure the autotuner's exposed-comm confirmation
+    # and weak_scaling.py's columns decompose.
+    mv = model_step_variants("diffusion3d")
+    fields = mv["init"](np.float32)
+    d = igg.comm.decompose(mv["compute"], fields[:mv["nf"]],
+                           aux=fields[mv["nf"]:], radius=mv["radius"],
+                           nt=3, n_inner=5)
     ok = (d["compute_ms"] > 0 and d["exchange_ms"] > 0
           and d["hidden_ms"] > 0
           and 0.0 <= d["exposed_comm_fraction"] <= 1.0)

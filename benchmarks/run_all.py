@@ -37,14 +37,16 @@ GOLDENS = HERE / "goldens"
 # against these: compare skips rows whose provenance
 # (backend, device_kind, smoke) does not match.
 GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput",
-               "halo_bandwidth", "overlap_study", "pallas_sweep")
+               "halo_bandwidth", "overlap_study", "pallas_sweep",
+               "weak_scaling_mesh8")
 # Tags whose goldens keep ONLY the contract rows (lines carrying a
 # "pass" flag): the comm benches' value rows are timer-noise-bound on
 # the shared smoke host (the halo_bandwidth docstring documents ~2x
 # spread at the tens-of-microseconds scale), so gating them would flake;
 # the contract rows (byte-accounting reconciliation, decomposition
 # well-formedness) are deterministic and gate strictly.
-GOLDEN_CONTRACT_ONLY = ("halo_bandwidth", "overlap_study", "pallas_sweep")
+GOLDEN_CONTRACT_ONLY = ("halo_bandwidth", "overlap_study", "pallas_sweep",
+                        "weak_scaling_mesh8")
 
 
 def run(script: str, args, *, virtual: int = 0, tag: str,

@@ -77,6 +77,83 @@ def run_once(model_run, devices, n: int, *, nt: int, n_inner: int,
     return sec, dims, served_tier
 
 
+def comm_point(model_name: str, devices, n: int, *, grid_kwargs=None):
+    """Per-point exposed-comm / overlap-efficiency columns: one
+    `igg.comm.decompose` window on the same (devices, local) point the
+    weak-scaling row measured, built from the shared step-variant recipe
+    (`igg.comm.model_step_variants`) — the decomposition samples land in
+    the perf ledger (family "comm", tier
+    "overlap.<model>.weak_scaling.*"), joinable with the row's own
+    ledger sample on the (dims, backend, device_kind) axes.  Returns the
+    fractions dict, or None for families without a recipe."""
+    import igg
+    from igg.comm import model_step_variants
+
+    try:
+        mv = model_step_variants(model_name)
+    except igg.GridError:
+        return None
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True, devices=devices,
+                         **{**(grid_kwargs or {}), **mv["grid_kwargs"]})
+    fields = mv["init"](np.float32)
+    d = igg.comm.decompose(mv["compute"], fields[:mv["nf"]],
+                           aux=fields[mv["nf"]:], radius=mv["radius"],
+                           nt=2, n_inner=4,
+                           config=f"{model_name}.weak_scaling")
+    igg.finalize_global_grid()
+    return d
+
+
+def overlap_contract(n: int = 16, n_inner: int = 3) -> bool:
+    """The always-on CPU-smoke overlap contract row (golden-gated,
+    contract-only — `benchmarks/run_all.py`): the
+    `hide_communication`-restructured diffusion step must serve
+    BITWISE-equal state to the sequential compute+exchange composition
+    on the full device mesh.  A structural claim, not a performance one
+    — it holds on the virtual CPU mesh exactly because the overlapped
+    program computes identical values in a reordered schedule, so any
+    future restructuring that breaks value-equality trips the golden
+    gate before it ships."""
+    import igg
+    import jax
+    import jax.numpy as jnp
+
+    from igg.models import diffusion3d as d3
+
+    devices = jax.devices()
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    igg.init_global_grid(n, n, n, periodx=1, periody=1, periodz=1,
+                         quiet=True, devices=devices)
+    grid = igg.get_global_grid()
+    p = d3.Params()
+    T, Cp = d3.init_fields(p, np.float32)
+    seq = d3.make_multi_step(n_inner, p, donate=False, use_pallas=False,
+                             overlap=False, tune=False)
+    ov = d3.make_multi_step(n_inner, p, donate=False, use_pallas=False,
+                            overlap=True, tune=False)
+    a, b = seq(T, Cp), ov(T, Cp)
+    ok = bool(jnp.all(a == b))
+    emit({
+        "metric": "overlap_contract",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bitwise-equal (1 = pass)",
+        "config": {"model": "diffusion3d", "local": n,
+                   "devices": grid.nprocs, "dims": list(grid.dims),
+                   "n_inner": n_inner,
+                   "platform": devices[0].platform},
+        "pass": ok,
+        "contract": "the hide_communication-restructured diffusion step "
+                    "is bitwise-equal to the sequential compute+exchange "
+                    "composition on the full device mesh",
+    })
+    igg.finalize_global_grid()
+    return ok
+
+
 def device_counts(ndev: int):
     """The measurement ladder 1,2,4,... plus the full mesh (always the last
     point — the configuration a pod runbook exists to capture)."""
@@ -146,6 +223,17 @@ def weak_curve(model_run, model_name: str, n: int, *, nt: int, n_inner: int,
             rec["collective_us"] = round(coll, 1)
             if sec > 1.5 * model:
                 rec["cause"] = _CAUSE
+        # Per-point step-time decomposition columns (round 16): how much
+        # of this point's step is exposed communication, and how much of
+        # it hide_communication recovers — measured in-run, ledgered.
+        dcmp = comm_point(model_name, devices[:k], n,
+                          grid_kwargs=grid_kwargs)
+        if dcmp is not None:
+            rec["exposed_comm_fraction"] = round(
+                dcmp["exposed_comm_fraction"], 4)
+            if "overlap_efficiency" in dcmp:
+                rec["overlap_efficiency"] = round(
+                    dcmp["overlap_efficiency"], 4)
         emit(rec)
 
 
@@ -201,6 +289,8 @@ def main():
 
     weak_curve(lambda *a, **kw: d3.run(*a, use_pallas=False, **kw),
                "diffusion3d", n, nt=nt, n_inner=n_inner, full=full)
+    # The always-on overlap contract row (golden-gated, contract-only).
+    overlap_contract()
 
 
 if __name__ == "__main__":

@@ -72,9 +72,13 @@ if phase == "cold":
     assert n_search > 0, "cold phase must have searched"
     w = autotune.get("diffusion3d")
     assert w is not None, "the winner must be cached"
+    # Round 16: the overlap axis is part of every persisted winner — the
+    # warm process must be able to serve the full
+    # (tier, K, bx, vmem, overlap) configuration from the cache alone.
+    assert isinstance(w.get("overlap"), bool), w
     print(f"cold: searched with {n_search} timed dispatches -> winner "
           f"tier={w['tier']} K={w['K']} bx={w['bx']} "
-          f"ms={w['ms']:.4f}")
+          f"overlap={w['overlap']} ms={w['ms']:.4f}")
 
     # The winner beats-or-equals the hand-picked bx=8 config (searched
     # samples carry per-candidate labels on the bus).
@@ -114,8 +118,17 @@ else:
     served = igg.degrade.active().get("diffusion3d")
     assert served == w["tier"], (served, w["tier"])
     assert autotune.search_dispatches() == 0
+    # The overlap axis round-trips the cache and resolves to the served
+    # schedule: overlap="auto" (the factory default) must follow the
+    # cached winner exactly (admission permitting — this 8-device
+    # radius-1 grid admits).
+    assert isinstance(w.get("overlap"), bool), w
+    from igg.overlap import resolve_overlap
+    assert resolve_overlap("auto", family="diffusion3d",
+                           tuned=w) == w["overlap"], w
     print(f"warm: served {served} with cached config "
-          f"K={w['K']} bx={w['bx']} after 0 search dispatches")
+          f"K={w['K']} bx={w['bx']} overlap={w['overlap']} "
+          f"after 0 search dispatches")
 
     # The CLI renders the cache next to its ledger prior.
     out = subprocess.run(

@@ -247,6 +247,7 @@ def record_winner(family: str, winner: Dict, *, local_shape=None,
          "device_kind": k[5],
          "tier": winner.get("tier"), "K": winner.get("K"),
          "bx": winner.get("bx"), "vmem_mb": winner.get("vmem_mb"),
+         "overlap": bool(winner.get("overlap", False)),
          "ms": winner.get("ms"), "source": source,
          "updated_wall": time.time()}
     with _lock:
@@ -420,6 +421,12 @@ def applied(family: str, tune, *, n_inner: int = 8, params=None,
 # The search
 # ---------------------------------------------------------------------------
 
+# (hide_communication read radius, decomposition rank) per built-in —
+# the admission geometry of each family's overlapped XLA candidate.
+_OVERLAP_GEOMETRY = {"diffusion3d": (1, 3), "stokes3d": (2, 3),
+                     "hm3d": (1, 3), "wave2d": (2, 2)}
+
+
 def candidates_for(family: str, *, n_inner: int = 8,
                    interpret: bool = False) -> List[Dict]:
     """The (tier, K, bx, vmem) candidate set admissible for `family` on
@@ -488,6 +495,17 @@ def candidates_for(family: str, *, n_inner: int = 8,
             f"diffusion3d, stokes3d, hm3d, wave2d; registered: "
             f"{sorted(_FAMILY_REGISTRY) or 'none'} — "
             f"igg.autotune.register_family hooks new ones in).")
+    # The overlapped XLA composition (igg.hide_communication) is a
+    # first-class candidate on the same axes: admission-gated host-side
+    # (radius vs ol-1, single-device mesh — igg.overlap.overlap_admission)
+    # so a refused variant never costs a search dispatch.  Chunk/mosaic
+    # tiers carry their own overlap semantics and get no variant.
+    from .overlap import overlap_admission
+
+    radius, nd = _OVERLAP_GEOMETRY[family]
+    if overlap_admission(radius, grid=grid, ndim=nd):
+        out.insert(1, {"tier": f"{family}.xla", "K": None, "bx": None,
+                       "vmem_mb": None, "overlap": True})
     return out
 
 
@@ -502,13 +520,15 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
                             interpret=interpret)
     tier = cand["tier"]
     fast = not tier.endswith(".xla")
+    ov = bool(cand.get("overlap"))
     if family == "diffusion3d":
         from .models import diffusion3d as m
 
         p = params or m.Params()
         T, Cp = m.init_fields(p, dtype=np.float32)
         step = m.make_multi_step(
-            n_inner, p, donate=False, use_pallas=(True if fast else False),
+            n_inner, p, donate=False, overlap=ov,
+            use_pallas=(True if fast else False),
             pallas_interpret=interpret, bx=cand.get("bx"), tune=False)
         return (lambda T, Cp: (step(T, Cp), Cp)), (T, Cp)
     if family == "stokes3d":
@@ -517,7 +537,7 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
         p = params or m.Params()
         fields = m.init_fields(p, dtype=np.float32)
         it = m.make_iteration(
-            p, donate=False, n_inner=n_inner,
+            p, donate=False, n_inner=n_inner, overlap=ov,
             use_pallas=(True if fast else False), pallas_interpret=interpret,
             trapezoid=(tier.endswith(".trapezoid")), K=cand.get("K"),
             tune=False)
@@ -529,7 +549,7 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
         p = params or m.Params()
         fields = m.init_fields(p, dtype=np.float32)
         step = m.make_step(
-            p, donate=False, n_inner=n_inner,
+            p, donate=False, n_inner=n_inner, overlap=ov,
             use_pallas=(True if fast else False), pallas_interpret=interpret,
             trapezoid=(tier.endswith(".trapezoid")), K=cand.get("K"),
             tune=False)
@@ -540,7 +560,7 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
         p = params or m.Params()
         fields = m.init_fields(p, dtype=np.float32)
         step = m.make_step(
-            p, donate=False, n_inner=n_inner,
+            p, donate=False, n_inner=n_inner, overlap=ov,
             use_pallas=(True if fast else False), pallas_interpret=interpret,
             chunk=(tier == "wave2d.chunk"), K=cand.get("K"), tune=False)
         return (lambda P, Vx, Vy: step(P, Vx, Vy)), tuple(fields)
@@ -549,6 +569,8 @@ def _build_candidate(family: str, cand: Dict, n_inner: int, params,
 
 def _cand_label(cand: Dict) -> str:
     bits = [cand["tier"]]
+    if cand.get("overlap"):
+        bits.append("overlap")
     if cand.get("K"):
         bits.append(f"K={cand['K']}")
     if cand.get("bx"):
@@ -657,5 +679,48 @@ def search(family: str, *, n_inner: int = 8, params=None,
     results.sort(key=lambda r: (r[0] if math.isfinite(r[0]) else
                                 float("inf")))
     ms, best = results[0]
+    if best.get("overlap") and not _overlap_confirmed(family, params,
+                                                      n_inner):
+        # The overlapped composition won the slope timing but the
+        # measured step-time decomposition shows no exposed-comm drop
+        # (hidden >= exchange): the timing win is noise or slab-recompute
+        # luck, not hidden communication — demote to the best
+        # non-overlapped candidate.  The decomposition samples are in the
+        # perf ledger (family "comm", tier "overlap.<family>.xla+overlap.*",
+        # source "calibrate"), so `igg.perf compare` gates the decision.
+        seq = next((r for r in results if not r[1].get("overlap")), None)
+        _telemetry.emit("overlap_demoted", family=family,
+                        overlapped_ms=ms,
+                        demoted_to=_cand_label(seq[1]) if seq else None)
+        if seq is not None:
+            ms, best = seq
     winner = dict(best, ms=ms)
     return record_winner(family, winner, local_shape=ctx["local_shape"])
+
+
+def _overlap_confirmed(family: str, params, n_inner: int) -> bool:
+    """Exposed-comm-driven selection: an overlapped candidate that wins
+    the slope timing is recorded ONLY when an in-search
+    :func:`igg.comm.decompose` window shows the hidden variant actually
+    beating the plain exchange (measured exposed communication drops) —
+    attributed to the ``"<family>.xla+overlap"`` serving config in the
+    comm ledger.  Families without a step-variant recipe (spec-compiled
+    ones measure through their own registered builders) pass on the
+    timing evidence alone."""
+    from . import comm
+
+    try:
+        mv = comm.model_step_variants(family, params)
+    except GridError:
+        return True
+    try:
+        fields = mv["init"](np.float32)
+        d = comm.decompose(mv["compute"], fields[:mv["nf"]],
+                           aux=fields[mv["nf"]:], radius=mv["radius"],
+                           nt=2, n_inner=max(2, int(n_inner) // 2),
+                           config=f"{family}.xla+overlap")
+    except Exception as e:   # a failed probe must not kill the search
+        _telemetry.emit("overlap_confirm_failed", family=family,
+                        error=f"{type(e).__name__}: {e}")
+        return True
+    return d["hidden_ms"] < d["exchange_ms"]

@@ -109,6 +109,7 @@ __all__ = [
     "plane_bytes_model", "link_peak_gbps", "record_exchange",
     "calibrate_comm", "decompose", "StepDecomposition", "StallWatchdog",
     "make_stall_watchdog", "active_stalls", "rank_skew",
+    "model_step_variants",
 ]
 
 
@@ -303,6 +304,95 @@ def calibrate_comm(nfields: int = 1, dtype=np.float32, *,
 # Step-time decomposition: compute-only / plain exchange / hidden overlap
 # ---------------------------------------------------------------------------
 
+def model_step_variants(family: str, params=None) -> Dict:
+    """The per-family step-variant recipe: everything a consumer needs to
+    build the overlapped / sequential / compute-only triple of one model
+    family's step — ONE definition of each family's pure-stencil closure,
+    full-step closure, field layout, and overlap radius, shared by
+    `benchmarks/overlap_study.py`, `benchmarks/overlap_schedule.py`,
+    `benchmarks/weak_scaling.py`'s exposed-comm columns, and the
+    autotuner's exposed-comm confirmation (each used to rebuild its own
+    copy of these closures).
+
+    Requires an initialized grid matching the family's `grid_kwargs`
+    (the coefficient closures read the global spacing).  Returns a dict:
+
+    - ``family``, ``params`` — the resolved family name and Params;
+    - ``nf`` / ``naux`` — primary-field and read-only-aux counts (the
+      state tuple is primaries then aux, the `init()` order);
+    - ``radius`` / ``ndim`` — the `hide_communication` read radius and
+      decomposition rank;
+    - ``stagger`` — per-field per-dim size offsets over the local
+      interior (primaries then aux), so AOT lowerings can reconstruct
+      global shapes for staggered fields;
+    - ``grid_kwargs`` — extra `init_global_grid` kwargs the family
+      requires (Stokes' radius-2 chain needs overlap-3 blocks);
+    - ``init(dtype)`` — the family's `init_fields` on the live grid;
+    - ``compute(*fields)`` — the pure shift-invariant stencil (no halo),
+      exactly what `hide_communication`/`decompose` require;
+    - ``local(*fields, overlap=False, assembly=...)`` — the full local
+      step (compute + grouped exchange, or the hidden restructuring).
+    """
+    # The coefficient dicts are computed LAZILY (at first closure call,
+    # i.e. trace time): spacing/timesteps read the live grid, but a
+    # consumer needs `grid_kwargs` BEFORE it can initialize that grid —
+    # so building the recipe itself requires none.
+    if family == "diffusion3d":
+        from .models import diffusion3d as m
+
+        p = params if params is not None else m.Params()
+
+        def kw():
+            dx, dy, dz = p.spacing()
+            return dict(dx=dx, dy=dy, dz=dz, dt=p.timestep(), lam=p.lam)
+
+        return dict(
+            family=family, params=p, nf=1, naux=1, radius=1, ndim=3,
+            stagger=((0, 0, 0), (0, 0, 0)), grid_kwargs={},
+            init=lambda dtype=np.float32: m.init_fields(p, dtype),
+            compute=lambda T, Cp: m.compute_step(T, Cp, **kw()),
+            local=lambda T, Cp, overlap=False, assembly="xla":
+                m.local_step(T, Cp, **kw(), overlap=overlap,
+                             assembly=assembly))
+    if family == "stokes3d":
+        from .models import stokes3d as m
+
+        p = params if params is not None else m.Params()
+        kw = lambda: m._pseudo_steps(p)   # noqa: E731
+        return dict(
+            family=family, params=p, nf=4, naux=1, radius=2, ndim=3,
+            stagger=((0, 0, 0), (1, 0, 0), (0, 1, 0), (0, 0, 1),
+                     (0, 0, 0)),
+            grid_kwargs=dict(overlapx=3, overlapy=3, overlapz=3),
+            init=lambda dtype=np.float32: m.init_fields(p, dtype),
+            compute=lambda P, Vx, Vy, Vz, Rho:
+                m.compute_iteration(P, Vx, Vy, Vz, Rho, **kw()),
+            local=lambda P, Vx, Vy, Vz, Rho, overlap=False, assembly=None:
+                m.local_iteration(P, Vx, Vy, Vz, Rho, **kw(),
+                                  overlap=overlap, assembly=assembly))
+    if family == "hm3d":
+        from .models import hm3d as m
+
+        p = params if params is not None else m.Params()
+
+        def kw():
+            dx, dy, dz = p.spacing()
+            return dict(dx=dx, dy=dy, dz=dz, dt=p.timestep(), phi0=p.phi0,
+                        npow=p.npow, eta=p.eta)
+
+        return dict(
+            family=family, params=p, nf=2, naux=0, radius=1, ndim=3,
+            stagger=((0, 0, 0), (0, 0, 0)), grid_kwargs={},
+            init=lambda dtype=np.float32: m.init_fields(p, dtype),
+            compute=lambda Pe, phi: m.compute_step(Pe, phi, **kw()),
+            local=lambda Pe, phi, overlap=False, assembly=None:
+                m.local_step(Pe, phi, **kw(), overlap=overlap,
+                             assembly=assembly))
+    raise GridError(
+        f"model_step_variants({family!r}): no step-variant recipe for "
+        f"this family (known: diffusion3d, stokes3d, hm3d)")
+
+
 def _build_variant(compute, nf: int, naux: int, specs, aux_specs, grid,
                    variant: str, reps: int, radius: int, assembly):
     """One jitted SPMD program applying `reps` iterations of the named
@@ -364,7 +454,8 @@ def _fractions(times_ms: Dict[str, float]) -> Dict[str, float]:
 
 
 def decompose(compute, fields, *, aux=(), radius: int = 1, assembly=None,
-              nt: int = 4, n_inner: int = 5, record: bool = True) -> Dict:
+              nt: int = 4, n_inner: int = 5, record: bool = True,
+              config: Optional[str] = None) -> Dict:
     """AOT step-time decomposition: slope-time the compute-only,
     compute+exchange, and hidden-overlap variants of one step
     (:func:`igg.time_steps` — the constant dispatch latency cancels) and
@@ -373,7 +464,11 @@ def decompose(compute, fields, *, aux=(), radius: int = 1, assembly=None,
     :func:`igg.hide_communication` requires; `fields`/`aux` are
     block-stacked grid arrays (scratch copies are taken — the caller's
     arrays are not consumed).  With `record`, each variant also lands in
-    the comm ledger (family ``"comm"``, tier ``overlap.<variant>``).
+    the comm ledger (family ``"comm"``, tier ``overlap.<variant>`` — or
+    ``overlap.<config>.<variant>`` when `config` names the serving
+    configuration being attributed, e.g. the autotuner's
+    ``"<family>.xla+overlap"`` confirmation samples, so
+    ``igg.perf compare`` gates each serving config separately).
     Returns the times and fractions dict (see :func:`_fractions`)."""
     import igg
     from . import perf
@@ -400,9 +495,10 @@ def decompose(compute, fields, *, aux=(), radius: int = 1, assembly=None,
         times_ms[variant] = sec / n_inner * 1e3
     out = _fractions(times_ms)
     ctx = perf.device_context()
+    stem = f"overlap.{config}" if config else "overlap"
     if record:
         for variant, ms in times_ms.items():
-            perf.record("comm", f"overlap.{variant}", ms,
+            perf.record("comm", f"{stem}.{variant}", ms,
                         source="calibrate",
                         local_shape=tuple(grid.local_shape(fields[0])),
                         dtype=str(fields[0].dtype),
@@ -410,8 +506,9 @@ def decompose(compute, fields, *, aux=(), radius: int = 1, assembly=None,
                         device_kind=ctx.get("device_kind"))
     _telemetry.gauge("igg_exposed_comm_fraction",
                      run="calibrate").set(out["exposed_comm_fraction"])
+    extra = {"config": config} if config else {}
     _telemetry.emit("comm_stats", run="calibrate", source="calibrate",
-                    n_inner=n_inner, **out)
+                    n_inner=n_inner, **extra, **out)
     return out
 
 
@@ -438,20 +535,31 @@ class StepDecomposition:
 
     When all three variants have a measurement, one ``comm_stats``
     record (source ``"probe"``) is emitted with the times and fractions
-    (:func:`_fractions`), the ``igg_exposed_comm_fraction{run=}`` /
-    ``igg_overlap_efficiency{run=}`` gauges are updated, and the
-    rotation restarts — per-window decomposition for as long as the run
-    lasts.  Single-controller only (probe dispatch depends on local
+    (:func:`_fractions`) — attributed to the SERVING CONFIG via its
+    ``config`` field (`config=` at construction, or auto-derived from
+    ``igg.degrade.active()``: the tiers actually dispatching when the
+    monitor was built, so an exposed-comm window can always be joined
+    back to the configuration that produced it) — the
+    ``igg_exposed_comm_fraction{run=}`` / ``igg_overlap_efficiency{run=}``
+    gauges are updated, and the rotation restarts — per-window
+    decomposition for as long as the run lasts.  Single-controller only (probe dispatch depends on local
     readiness timing; `run_resilient` warns it off on multi-process
     runs, the `verify="first_use"` precedent)."""
 
     _MIN_DT = 1e-4
 
     def __init__(self, compute, fields, *, aux=(), radius: int = 1,
-                 assembly=None, reps: int = 4, run: str = "resilient"):
+                 assembly=None, reps: int = 4, run: str = "resilient",
+                 config: Optional[str] = None):
         from .fields import spec_for
 
         shared.check_initialized()
+        if config is None:
+            from . import degrade
+
+            served = sorted(set(degrade.active().values()))
+            config = ",".join(served) if served else None
+        self.config = config
         grid = shared.global_grid()
         fields = (tuple(fields) if isinstance(fields, (tuple, list))
                   else (fields,))
@@ -541,8 +649,9 @@ class StepDecomposition:
         self._g_exposed.set(out["exposed_comm_fraction"])
         if "overlap_efficiency" in out:
             self._g_eff.set(out["overlap_efficiency"])
+        extra = {"config": self.config} if self.config else {}
         _telemetry.emit("comm_stats", step=step, run=self.run,
-                        source="probe", reps=self._reps, **out)
+                        source="probe", reps=self._reps, **extra, **out)
         return out
 
     def finalize(self, step: int, timeout_s: float = 10.0) -> None:

@@ -192,18 +192,61 @@ def _factor_triples(n: int) -> Tuple[Tuple[int, int, int], ...]:
                         key=lambda t: (max(t) - min(t), -t[0], -t[1])))
 
 
+def _plan_score(dims, local, itemsize: int, hops):
+    """Wire-bytes × link-hop score of one candidate mapping, plus the
+    per-link traffic breakdown the `dims_planned` record carries."""
+    elems = 1
+    for n in local:
+        elems *= int(n)
+    nprocs = 1
+    for d in dims:
+        nprocs *= int(d)
+    per_link = []
+    total = 0.0
+    for d in range(NDIMS):
+        if dims[d] <= 1:
+            continue
+        b = 2 * (elems // int(local[d])) * int(itemsize) * nprocs
+        h = float(hops[d]) if hops else 1.0
+        per_link.append({"dim": "xyz"[d], "devices": int(dims[d]),
+                         "wire_bytes_per_exchange": int(b),
+                         "mean_link_hops": round(h, 3)})
+        total += b * (h if h > 0 else 1.0)
+    return total, per_link
+
+
 def plan_dims(global_interior, n_devices: int, *, periods=(1, 1, 1),
-              overlaps=(2, 2, 2)) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+              overlaps=(2, 2, 2), itemsize: int = 8,
+              devices=None) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
     """Plan a Cartesian decomposition of `global_interior` onto AT MOST
-    `n_devices` devices: the largest device count with a balanced factor
-    triple whose dims divide the interior per dim and keep every local
-    size a legal grid (`nx >= 2`, periodic dims >= `2*ol - 1`).  Returns
+    `n_devices` devices: the largest device count with a factor triple
+    whose dims divide the interior per dim and keep every local size a
+    legal grid (`nx >= 2`, periodic dims >= `2*ol - 1`).  Returns
     `(dims, local)` — the `init_global_grid` arguments; raises `GridError`
-    when not even one device fits."""
+    when not even one device fits.
+
+    Balance stays the primary preference (the `MPI_Dims_create`
+    contract the fleet/heal re-tile paths rely on), but EQUAL-BALANCE
+    triples at the chosen device count are now tie-broken by predicted
+    wire traffic instead of first-found enumeration order: total wire
+    halo-plane bytes for the job's actual local shape
+    (`igg.topology.plane_wire_bytes` — the `plane_bytes_by_mode` wire
+    accounting at `itemsize` bytes/cell), each split dimension weighted
+    by the mean physical ICI hop count of its mesh axis under the real
+    `mesh_utils.create_device_mesh` placement (`igg.topology.link_hops`;
+    every axis weighs 1 where the devices expose no physical coords —
+    CPU meshes).  Score ties keep the original order, so isotropic
+    interiors plan exactly as before.  The chosen mapping is logged as a
+    ``dims_planned`` telemetry record carrying the predicted per-link
+    traffic."""
+    from . import telemetry as _telemetry
+    from .topology import link_hops
+
     g = [int(v) for v in global_interior]
     per = [int(v) for v in periods]
     ol = [int(v) for v in overlaps]
     for nd in range(int(n_devices), 0, -1):
+        legal = []
         for dims in _factor_triples(nd):
             local = []
             for d in range(NDIMS):
@@ -220,7 +263,28 @@ def plan_dims(global_interior, n_devices: int, *, periods=(1, 1, 1),
                 continue
             if local[1] == 1 and local[2] > 1:
                 continue          # init_global_grid's ny/nz rule
-            return tuple(dims), tuple(local)
+            legal.append((tuple(dims), tuple(local)))
+        if not legal:
+            continue
+        best = None
+        for idx, (dims, local) in enumerate(legal):
+            hops = (link_hops(dims, devices=devices)
+                    if len(legal) > 1 else None)
+            score, per_link = _plan_score(dims, local, itemsize, hops)
+            # Primary key: balance (the MPI_Dims_create preference the
+            # re-tile paths rely on); wire cost only breaks its ties.
+            key = (max(dims) - min(dims), score, idx)
+            if best is None or key < best[0]:
+                best = (key, dims, local, per_link,
+                        "physical" if hops else "uniform")
+        (_, score, _), dims, local, per_link, hop_src = best
+        _telemetry.emit("dims_planned", global_interior=list(g),
+                        n_devices=int(n_devices), dims=list(dims),
+                        local=list(local), itemsize=int(itemsize),
+                        candidates=len(legal), hop_cost=hop_src,
+                        predicted_wire_cost=round(float(score), 1),
+                        per_link=per_link)
+        return dims, local
     raise GridError(
         f"plan_dims: no decomposition of global interior {g} "
         f"(periods {per}, overlaps {ol}) fits onto <= {n_devices} "

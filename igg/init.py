@@ -158,7 +158,12 @@ def init_global_grid(nx: int, ny: int, nz: int, *,
         nprocs = int(np.prod(dims))
     else:
         nprocs = nprocs_avail
-    dims = np.array(dims_create(nprocs, dims), dtype=int)
+    # The free dims are tie-broken by predicted wire traffic for THIS
+    # local block (equal-balance permutations only — isotropic blocks
+    # keep the MPI_Dims_create order exactly).
+    dims = np.array(dims_create(nprocs, dims,
+                                local_shape=(int(nx), int(ny), int(nz))),
+                    dtype=int)
 
     mesh = create_mesh(tuple(dims), devices=devices, reorder=reorder)
 

@@ -266,7 +266,10 @@ def apply_tuned(family, tune, *, n_inner, interpret, K, chunk_knob,
       left BOTH knobs on auto — an explicit chunk/trapezoid=True always
       outranks a cached winner.
 
-    Returns `(K, K_from_cache, chunk_knob, use_pallas)`."""
+    Returns `(K, K_from_cache, chunk_knob, use_pallas, tuned)` — `tuned`
+    is the raw winner entry (or None), so the factory can resolve its
+    remaining auto knobs (the overlap axis,
+    `igg.overlap.resolve_overlap`) from the same lookup."""
     from igg import autotune
 
     tuned = autotune.applied(family, tune, n_inner=n_inner,
@@ -280,7 +283,7 @@ def apply_tuned(family, tune, *, n_inner, interpret, K, chunk_knob,
     if use_pallas == "auto" and chunk_knob == "auto" and tuned and \
             tuned.get("tier") == f"{family}.xla":
         use_pallas = False
-    return K, K_from_cache, chunk_knob, use_pallas
+    return K, K_from_cache, chunk_knob, use_pallas, tuned
 
 
 def resolve_chunk_K(K, K_from_cache, supported, fit):

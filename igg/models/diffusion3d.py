@@ -173,7 +173,7 @@ def _best_bx(S0: int) -> int:
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
-              use_pallas="auto", overlap: bool = False,
+              use_pallas="auto", overlap="auto",
               pallas_interpret: bool = False, verify=None, tune=None):
     """Compiled whole-step function `(T, Cp) -> T` over the grid mesh.
 
@@ -184,7 +184,9 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     raises if inapplicable.
     `overlap`: restructure the XLA step with `igg.hide_communication` (the
     Pallas step has overlap semantics built in — its halo exchange is always
-    data-independent of the main kernel).
+    data-independent of the main kernel).  "auto" (default) follows the
+    `IGG_OVERLAP` knob, then the autotuner's cached winner, else off
+    (`igg.overlap.resolve_overlap`).
     `pallas_interpret`: run the kernel in interpret mode (testing on CPU).
     `verify`: "first_use" numerically checks the fused tier against the
     XLA composition before it serves traffic (`igg.degrade`; defaults to
@@ -197,7 +199,7 @@ def make_step(params: Params = Params(), *, donate: bool = True,
 
 def make_multi_step(n_inner: int, params: Params = Params(), *,
                     donate: bool = True, use_pallas="auto",
-                    overlap: bool = False, pallas_interpret: bool = False,
+                    overlap="auto", pallas_interpret: bool = False,
                     bx: int = None, verify=None, tune=None):
     """Compiled `(T, Cp) -> T` advancing `n_inner` steps in ONE XLA program
     (`lax.fori_loop` around the step, halo ppermutes included).  This is the
@@ -212,10 +214,12 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
     `tune` consults the autotuner's cached winner for this signature
     ("auto"/True/False, default the `IGG_TUNE` knob; `igg.autotune`):
     a hit supplies the slab/chunk depth `bx` and may pin the tier when
-    the caller left the defaults — K is then searched, not fixed."""
+    the caller left the defaults — K is then searched, not fixed, and
+    the winner's persisted overlap axis resolves `overlap="auto"`."""
     from jax import lax
 
     from igg import autotune
+    from igg.overlap import resolve_overlap
 
     tuned = autotune.applied("diffusion3d", tune, n_inner=n_inner,
                              interpret=pallas_interpret)
@@ -224,6 +228,8 @@ def make_multi_step(n_inner: int, params: Params = Params(), *,
     if use_pallas == "auto" and tuned and \
             tuned.get("tier") == "diffusion3d.xla":
         use_pallas = False
+    overlap = resolve_overlap(overlap, family="diffusion3d", tuned=tuned,
+                              radius=1)
 
     dx, dy, dz = params.spacing()
     dt = params.timestep()
@@ -301,7 +307,7 @@ _integrity.register_invariants("diffusion3d", [
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,
         warmup: int = 1, n_inner: int = 1, use_pallas="auto",
-        overlap: bool = False, pallas_interpret: bool = False,
+        overlap="auto", pallas_interpret: bool = False,
         bx: int = None):
     """Slope-timed run (see :func:`igg.time_steps`): the `nt` timed
     dispatches are split into slope batches of ~nt/4 and ~3nt/4, each

@@ -156,7 +156,7 @@ _TRAPEZOID_REQ = (
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
-              overlap: bool = False, n_inner: int = 1,
+              overlap="auto", n_inner: int = 1,
               use_pallas="auto", pallas_interpret: bool = False,
               trapezoid="auto", K: int = None, verify=None, tune=None):
     """Compiled `(Pe, phi) -> (Pe, phi)` advancing `n_inner` steps in one
@@ -165,9 +165,11 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     TPU devices, overlap-2 grid, f32 fields, any device count/periodicity;
     False forces the portable shard_map/XLA path; True requires the kernel
     and raises if inapplicable.  `overlap` restructures the XLA path with
-    `igg.hide_communication`; the fused kernel has overlap semantics built
-    in (its exchange is always data-independent of the main kernel), so it
-    satisfies both settings — exactly like diffusion3d.
+    `igg.hide_communication` ("auto" follows the `IGG_OVERLAP` knob, then
+    the autotuner's cached winner, else off); the fused kernel has overlap
+    semantics built in (its exchange is always data-independent of the
+    main kernel), so it satisfies both settings — exactly like
+    diffusion3d.
     `verify`: "first_use" numerically checks the fused tier against the
     XLA composition before it serves traffic (`igg.degrade`; defaults to
     the `IGG_VERIFY_KERNELS` environment knob).
@@ -190,11 +192,15 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     # NOTE: the step closures capture only hashable scalars so recreated
     # closures share one compiled program (`igg.parallel._fn_key`).
 
+    from igg.overlap import resolve_overlap
+
     from ._dispatch import apply_tuned
 
-    K, K_from_cache, trapezoid, use_pallas = apply_tuned(
+    K, K_from_cache, trapezoid, use_pallas, tuned = apply_tuned(
         "hm3d", tune, n_inner=n_inner, interpret=pallas_interpret, K=K,
         chunk_knob=trapezoid, use_pallas=use_pallas)
+    overlap = resolve_overlap(overlap, family="hm3d", tuned=tuned,
+                              radius=1, chunk_active=trapezoid is True)
 
     def build_xla(assembly):
         def xla_steps(Pe, phi):
@@ -330,7 +336,7 @@ def make_step(params: Params = Params(), *, donate: bool = True,
 
 
 def run(nt: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1, use_pallas="auto"):
+        overlap="auto", n_inner: int = 1, use_pallas="auto"):
     """Slope-timed run (see :func:`igg.time_steps`)."""
     Pe, phi = init_fields(params, dtype=dtype)
     step = make_step(params, overlap=overlap, n_inner=n_inner,

@@ -208,7 +208,7 @@ def _pseudo_steps(params: Params):
 
 
 def make_iteration(params: Params = Params(), *, donate: bool = True,
-                   overlap: bool = False, n_inner: int = 1,
+                   overlap="auto", n_inner: int = 1,
                    use_pallas="auto", pallas_interpret: bool = False,
                    trapezoid="auto", K: int = None, verify=None,
                    tune=None):
@@ -218,8 +218,10 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     overlap-3 grid, f32 fields, any device count/periodicity; False forces
     the portable shard_map/XLA path; True requires the kernel and raises if
     inapplicable.  `overlap` restructures the XLA path with
-    `igg.hide_communication`; the fused kernel has overlap semantics built
-    in, so it satisfies both settings.
+    `igg.hide_communication` ("auto" follows the `IGG_OVERLAP` knob, then
+    the autotuner's cached winner — the Gauss-Seidel iteration has radius
+    2, so admission needs an overlap-3 grid); the fused kernel has overlap
+    semantics built in, so it satisfies both settings.
 
     `trapezoid` admits the K-iteration temporal-blocking chunk tier
     (`igg.ops.stokes_trapezoid`) on top of the fused kernel: "auto"
@@ -242,11 +244,15 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
     and may pin the tier when the caller left the defaults."""
     from jax import lax
 
+    from igg.overlap import resolve_overlap
+
     from ._dispatch import apply_tuned
 
-    K, K_from_cache, trapezoid, use_pallas = apply_tuned(
+    K, K_from_cache, trapezoid, use_pallas, tuned = apply_tuned(
         "stokes3d", tune, n_inner=n_inner, interpret=pallas_interpret,
         K=K, chunk_knob=trapezoid, use_pallas=use_pallas)
+    overlap = resolve_overlap(overlap, family="stokes3d", tuned=tuned,
+                              radius=2, chunk_active=trapezoid is True)
 
     kw = _pseudo_steps(params)
     dx, dy, dz = kw["dx"], kw["dy"], kw["dz"]
@@ -401,7 +407,7 @@ def make_iteration(params: Params = Params(), *, donate: bool = True,
 
 
 def run(n_iters: int, params: Params = Params(), dtype=np.float32,
-        overlap: bool = False, n_inner: int = 1, use_pallas="auto"):
+        overlap="auto", n_inner: int = 1, use_pallas="auto"):
     """Slope-timed relaxation (see :func:`igg.time_steps`); returns fields
     and seconds/iteration."""
     P, Vx, Vy, Vz, Rho = init_fields(params, dtype=dtype)

@@ -81,9 +81,19 @@ def compute_step(P, Vx, Vy, *, dx, dy, dt, rho, K):
     return P, Vx, Vy
 
 
-def local_step(P, Vx, Vy, *, dx, dy, dt, rho, K):
-    """One leapfrog step over per-device local arrays."""
-    P, Vx, Vy = compute_step(P, Vx, Vy, dx=dx, dy=dy, dt=dt, rho=rho, K=K)
+def local_step(P, Vx, Vy, *, dx, dy, dt, rho, K, overlap: bool = False,
+               assembly=None):
+    """One leapfrog step over per-device local arrays.  With
+    `overlap=True` the step is restructured by
+    :func:`igg.hide_communication` (radius 2 — the velocity/pressure
+    chain — so the grid needs overlap >= 3 along the exchanged dims)."""
+    kw = dict(dx=dx, dy=dy, dt=dt, rho=rho, K=K)
+    if overlap:
+        return igg.hide_communication(
+            (P, Vx, Vy),
+            lambda Pb, Vxb, Vyb: compute_step(Pb, Vxb, Vyb, **kw),
+            radius=2, assembly=assembly)
+    P, Vx, Vy = compute_step(P, Vx, Vy, **kw)
     return igg.update_halo_local(P, Vx, Vy)
 
 
@@ -104,7 +114,7 @@ _CHUNK_REQ = (
 
 
 def make_step(params: Params = Params(), *, donate: bool = True,
-              n_inner: int = 1, use_pallas="auto",
+              overlap="auto", n_inner: int = 1, use_pallas="auto",
               pallas_interpret: bool = False, chunk="auto", K: int = None,
               verify=None, tune=None):
     """Compiled `(P, Vx, Vy) -> (P, Vx, Vy)` advancing `n_inner` steps in
@@ -117,12 +127,18 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     and raises `GridError` when inapplicable.  `chunk` admits the K-step
     temporal-blocking tier on top ("auto"/False/True, the
     `stokes3d.make_iteration` contract); `K` overrides the auto-fitted
-    chunk depth.  `verify="first_use"` (or `IGG_VERIFY_KERNELS=1`)
+    chunk depth.  `overlap` restructures the XLA composition with
+    `igg.hide_communication` ("auto" follows the `IGG_OVERLAP` knob, then
+    the autotuner's cached winner — the coupled leapfrog has radius 2, so
+    admission needs overlap >= 3; the fused tiers have overlap semantics
+    built in).  `verify="first_use"` (or `IGG_VERIFY_KERNELS=1`)
     numerically checks each fast tier against the truth before it serves
     traffic.  `tune` consults the autotuner's cached winner for this
     signature ("auto"/True/False; `igg.autotune` — True searches on a
     cache miss)."""
     from jax import lax
+
+    from igg.overlap import resolve_overlap
 
     dx, dy = params.spacing()
     dt = params.timestep()
@@ -132,9 +148,12 @@ def make_step(params: Params = Params(), *, donate: bool = True,
 
     from ._dispatch import apply_tuned
 
-    K, K_from_cache, chunk, use_pallas = apply_tuned(
+    K, K_from_cache, chunk, use_pallas, tuned = apply_tuned(
         "wave2d", tune, n_inner=n_inner, interpret=pallas_interpret, K=K,
         chunk_knob=chunk, use_pallas=use_pallas)
+    overlap = resolve_overlap(overlap, family="wave2d", tuned=tuned,
+                              radius=2, ndim=2,
+                              chunk_active=chunk is True)
 
     def step_kw():
         return dict(dx=dx, dy=dy, dt=dt, rho=rho, K=bulk)
@@ -142,7 +161,7 @@ def make_step(params: Params = Params(), *, donate: bool = True,
     def xla_steps(P, Vx, Vy):
         return lax.fori_loop(
             0, n_inner,
-            lambda _, S: local_step(*S, **step_kw()),
+            lambda _, S: local_step(*S, **step_kw(), overlap=overlap),
             (P, Vx, Vy))
 
     donate_argnums = (0, 1, 2) if donate else ()

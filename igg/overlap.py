@@ -221,3 +221,94 @@ def _hide_impl(fields, aux, compute, radius, assembly, grid, single, s0,
                                   assembly=assembly)
                    for i, out in enumerate(outs))
     return result[0] if single else result
+
+
+# ---------------------------------------------------------------------------
+# Overlap as a SERVING configuration (round 16): the factories' overlap=
+# "auto"/True/False contract plus the structured admission the autotuner
+# and igg.degrade consult before the overlapped variant may serve traffic.
+# ---------------------------------------------------------------------------
+
+def overlap_admission(radius: int = 1, *, grid=None, ndim: int = 3,
+                      chunk_active: bool = False):
+    """Whether the overlapped step variant is ADMISSIBLE as a serving
+    configuration on the live grid — an :class:`igg.degrade.Admission`
+    carrying the structured refusal reason:
+
+    - ``radius > ol-1``: the send planes cannot be slab-computed from
+      in-block data (:func:`hide_communication` would raise at trace
+      time — initialize the grid with a larger overlap);
+    - single-device mesh: every exchange is a local plane copy, there is
+      no wire latency to hide behind the interior compute;
+    - an active chunk/trapezoid tier: the K-step kernels already
+      amortize one halo update over K interior steps, so restructuring
+      the per-step exchange buys nothing.
+
+    `ndim` bounds the participating grid dimensions (2 for the 2-D
+    families).  Pure host arithmetic; never raises."""
+    from .degrade import Admission
+
+    if grid is None:
+        if not shared.grid_is_initialized():
+            return Admission.no("no grid initialized")
+        grid = shared.global_grid()
+    r = int(radius)
+    for d in range(min(int(ndim), len(grid.overlaps))):
+        ol = int(grid.overlaps[d])
+        if r > ol - 1:
+            return Admission.no(
+                f"stencil radius {r} exceeds ol-1={ol - 1} along dimension "
+                f"{d}: the send planes cannot be computed from in-block "
+                f"data (initialize the grid with overlap >= {r + 1})")
+    if all(int(dm) == 1 for dm in grid.dims[:int(ndim)]):
+        return Admission.no(
+            "single-device mesh: every exchange is a local plane copy, "
+            "there is no wire to hide")
+    if chunk_active:
+        return Admission.no(
+            "chunk tier already amortizes the exchange (one halo update "
+            "per K interior steps)")
+    return Admission.yes()
+
+
+def resolve_overlap(overlap, *, family: str, tuned=None, radius: int = 1,
+                    ndim: int = 3, chunk_active: bool = False) -> bool:
+    """The factories' ``overlap=`` contract: ``True``/``False`` are
+    explicit caller pins (True still trace-time-validates inside
+    :func:`hide_communication`); ``"auto"`` resolves, in order:
+
+    1. the ``IGG_OVERLAP`` knob — a set value forces on (1/true/on) or
+       pins off (0/false/off) every auto knob in the process;
+    2. the autotuner's cached winner for this signature (its persisted
+       ``overlap`` axis, `igg.autotune`);
+    3. off — the sequential composition stays the default with no
+       winner.
+
+    A resolved True is admission-gated by :func:`overlap_admission`: a
+    refusal DEGRADES to the sequential composition (recorded in
+    `igg.degrade.admission_log()` under ``{family}.overlap`` and emitted
+    as an ``overlap_refused`` bus record) rather than raising — auto
+    mode must never crash a serving path."""
+    from . import _env, degrade
+
+    if overlap in (True, False):
+        return bool(overlap)
+    if overlap != "auto":
+        raise GridError(
+            f"overlap={overlap!r}: expected True, False, or 'auto'.")
+    forced = _env.text("IGG_OVERLAP")
+    if forced is not None:
+        want = _env.flag("IGG_OVERLAP")
+    elif tuned is not None and tuned.get("overlap") is not None:
+        want = bool(tuned["overlap"])
+    else:
+        want = False
+    if not want:
+        return False
+    adm = overlap_admission(radius, ndim=ndim, chunk_active=chunk_active)
+    if not adm:
+        degrade._ADMISSION_LOG[f"{family}.overlap"] = adm.reason
+        _telemetry.emit("overlap_refused", family=family, radius=radius,
+                        reason=adm.reason)
+        return False
+    return True

@@ -76,6 +76,7 @@ def _register_family(spec: StencilSpec, analysis, cf: Dict) -> None:
         import numpy as np
 
         def candidates(grid, *, n_inner, interpret):
+            from ..overlap import overlap_admission
             from .lower import chunk_supported_fn
 
             nd = spec.ndim
@@ -84,6 +85,13 @@ def _register_family(spec: StencilSpec, analysis, cf: Dict) -> None:
                     "vmem_mb": None},
                    {"tier": f"{spec.name}.mosaic", "K": None, "bx": None,
                     "vmem_mb": None}]
+            # The overlapped XLA variant rides the analyzer's read-set
+            # radius: any spec whose halo radius fits ol-1 is a search
+            # candidate with no per-spec code.
+            r = max(analysis.halo_radius) if analysis.halo_radius else 1
+            if overlap_admission(r, grid=grid, ndim=nd):
+                out.append({"tier": f"{spec.name}.xla", "K": None,
+                            "bx": None, "vmem_mb": None, "overlap": True})
             sup = chunk_supported_fn(spec, analysis)
             for K in (4, 8):
                 if sup(grid, shape, K, n_inner - 1, np.float32,
@@ -99,6 +107,7 @@ def _register_family(spec: StencilSpec, analysis, cf: Dict) -> None:
             step = compile(
                 spec, coeffs=cf, donate=False, n_inner=n_inner,
                 use_pallas=(True if fast else False),
+                overlap=bool(cand.get("overlap")),
                 pallas_interpret=interpret,
                 chunk=(tier == f"{spec.name}.chunk"), K=cand.get("K"),
                 tune=False)
@@ -110,7 +119,7 @@ def _register_family(spec: StencilSpec, analysis, cf: Dict) -> None:
 
 def compile(spec: StencilSpec, *, coeffs: Optional[Dict] = None,
             donate: bool = True, n_inner: int = 1, use_pallas="auto",
-            pallas_interpret: bool = False, chunk="auto",
+            overlap="auto", pallas_interpret: bool = False, chunk="auto",
             K: Optional[int] = None, verify=None, tune=None):
     """Compiled `(*fields) -> (*fields)` advancing `n_inner` steps in one
     SPMD program, dispatched through the spec's degradation ladder
@@ -119,6 +128,11 @@ def compile(spec: StencilSpec, *, coeffs: Optional[Dict] = None,
     `coeffs` binds the spec's scalar Params (declared defaults fill the
     rest); the remaining knobs carry the model-factory contract verbatim
     — `use_pallas` "auto"/True/False, `chunk`/`K` for the K-step tier,
+    `overlap` "auto"/True/False to restructure the generated XLA
+    composition with `igg.hide_communication` (the analyzer's read-set
+    radius drives the admission for free: a spec whose
+    `analysis.halo_radius` fits `ol-1` is overlap-admissible with no
+    per-spec code — `igg.overlap.resolve_overlap`),
     `verify="first_use"` (or `IGG_VERIFY_KERNELS=1`) to numerically
     check each generated tier against the generated XLA truth before it
     serves traffic, `tune` to consult the autotuner's cached winner.
@@ -129,6 +143,7 @@ def compile(spec: StencilSpec, *, coeffs: Optional[Dict] = None,
 
     from ..models._dispatch import (apply_tuned, auto_dispatch,
                                     pallas_applicable, resolve_chunk_K)
+    from ..overlap import resolve_overlap
     from . import lower
 
     igg.get_global_grid()      # factories need the live grid
@@ -141,13 +156,27 @@ def compile(spec: StencilSpec, *, coeffs: Optional[Dict] = None,
 
     _register_family(spec, analysis, cf)
 
-    K, K_from_cache, chunk, use_pallas = apply_tuned(
+    K, K_from_cache, chunk, use_pallas, tuned = apply_tuned(
         spec.name, tune, n_inner=n_inner, interpret=pallas_interpret, K=K,
         chunk_knob=chunk, use_pallas=use_pallas)
+    radius = max(analysis.halo_radius) if analysis.halo_radius else 1
+    overlap = resolve_overlap(overlap, family=spec.name, tuned=tuned,
+                              radius=radius, ndim=spec.ndim,
+                              chunk_active=chunk is True)
 
     local_step = lower.local_step_fn(spec, cf)
 
     def xla_steps(*fields):
+        if overlap:
+            def one(S):
+                out = igg.hide_communication(
+                    tuple(S),
+                    lambda *fs: tuple(lower.apply_updates(spec, fs, cf)),
+                    radius=radius)
+                return out if isinstance(out, tuple) else (out,)
+
+            return lax.fori_loop(0, n_inner, lambda _, S: one(S),
+                                 tuple(fields))
         return lax.fori_loop(0, n_inner, lambda _, S: local_step(*S),
                              tuple(fields))
 

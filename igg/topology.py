@@ -30,13 +30,24 @@ def _prime_factors(n: int) -> List[int]:
     return fs
 
 
-def dims_create(nprocs: int, dims: Sequence[int]) -> Tuple[int, ...]:
+def dims_create(nprocs: int, dims: Sequence[int], *,
+                local_shape: Optional[Sequence[int]] = None,
+                itemsize: int = 8) -> Tuple[int, ...]:
     """Balanced factorization of `nprocs` over the free (0) entries of `dims`.
 
     Mirrors the semantics of `MPI_Dims_create` used by the reference
     (`/root/reference/src/init_global_grid.jl:74`): fixed (non-zero) entries
     are kept, free entries are chosen as close to each other as possible and
     assigned in non-increasing order.
+
+    With `local_shape` (the per-device block the decomposition will
+    carry) the assignment of the balanced slot multiset onto the free
+    dimensions is additionally TIE-BROKEN by predicted wire traffic:
+    among the permutations of the same (equally balanced) slots, the one
+    minimizing :func:`plane_wire_bytes` for that block wins — e.g. on a
+    pancake-shaped block the unsplit slot lands on the dimension with
+    the largest exchange plane.  Ties keep the `MPI_Dims_create`
+    non-increasing order, so isotropic blocks are unchanged.
     """
     dims = [int(d) for d in dims]
     if len(dims) != NDIMS:
@@ -65,6 +76,96 @@ def dims_create(nprocs: int, dims: Sequence[int]) -> Tuple[int, ...]:
     out = list(dims)
     for i, s in zip(free_idx, slots):
         out[i] = s
+    if local_shape is not None and len(set(slots)) > 1:
+        import itertools
+
+        ls = [int(v) for v in local_shape]
+        best, best_bytes = None, None
+        # Reverse-lexicographic order is deterministic and puts the
+        # MPI-ordered assignment (slots already non-increasing) first,
+        # so a wire-bytes tie preserves it exactly.
+        for perm in sorted(set(itertools.permutations(slots)),
+                           reverse=True):
+            cand = list(dims)
+            for i, s in zip(free_idx, perm):
+                cand[i] = s
+            b = plane_wire_bytes(cand, ls, itemsize=itemsize)
+            if best_bytes is None or b < best_bytes:
+                best, best_bytes = cand, b
+        out = best
+    return tuple(out)
+
+
+def plane_wire_bytes(dims: Sequence[int], local: Sequence[int],
+                     itemsize: int = 8, nfields: int = 1) -> int:
+    """Total WIRE halo-plane bytes of one grouped exchange for `nfields`
+    same-shaped fields on `local`-shaped blocks under the `dims`
+    decomposition — the host-side mirror of
+    `igg.halo.plane_bytes_by_mode`'s wire accounting (2 planes per
+    device side per split dimension, ``elems // local[d]`` cells each,
+    summed over the mesh), computable BEFORE any grid exists so
+    decomposition planners (:func:`igg.fleet.plan_dims`,
+    :func:`dims_create` with a `local_shape`) can score candidate factor
+    triples.  A dimension with ``dims[d] == 1`` exchanges only local
+    plane copies and contributes nothing."""
+    dims = [int(d) for d in dims]
+    local = [int(n) for n in local]
+    nprocs = 1
+    for d in dims:
+        nprocs *= d
+    elems = 1
+    for n in local:
+        elems *= n
+    total = 0
+    for d in range(min(len(dims), len(local))):
+        if dims[d] > 1:
+            total += (2 * int(nfields) * (elems // local[d])
+                      * int(itemsize) * nprocs)
+    return total
+
+
+def link_hops(dims: Sequence[int],
+              devices: Optional[Sequence] = None
+              ) -> Optional[Tuple[float, ...]]:
+    """Mean physical ICI hop count of one neighbor exchange along each
+    mesh axis under the ACTUAL `mesh_utils.create_device_mesh` placement
+    for `dims` — the per-axis cost weight :func:`igg.fleet.plan_dims`
+    multiplies into its wire-bytes score, so a factor triple whose heavy
+    axis lands on a multi-hop ICI mapping loses to one that rides
+    single-hop links.  Hop distance is torus Manhattan distance between
+    the chip coordinates of each adjacent device pair (wraparound
+    included; torus extents inferred from the occupied coordinate
+    ranges).  Returns None when the devices expose no physical `coords`
+    (CPU/virtual meshes) or placement fails — the caller then weights
+    every axis equally."""
+    dims = tuple(int(d) for d in dims)
+    try:
+        import jax
+
+        devs = list(devices) if devices is not None else jax.devices()
+        n = int(np.prod(dims))
+        if n == 1 or len(devs) < n:
+            return None
+        devs = devs[:n]
+        if getattr(devs[0], "coords", None) is None:
+            return None
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(dims, devices=devs,
+                                            allow_split_physical_axes=True)
+    except Exception:
+        return None
+    coords = np.array([list(d.coords) for d in arr.flat])
+    ext = coords.max(axis=0) - coords.min(axis=0) + 1   # torus extents
+    coords = coords.reshape(dims + (-1,))
+    out = []
+    for ax in range(len(dims)):
+        if dims[ax] == 1:
+            out.append(0.0)
+            continue
+        diff = np.abs(coords - np.roll(coords, -1, axis=ax))
+        hop = np.minimum(diff, ext - diff).sum(axis=-1)
+        out.append(float(hop.mean()))
     return tuple(out)
 
 

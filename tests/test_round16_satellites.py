@@ -1,0 +1,232 @@
+"""Round-16 satellites: overlap serving composed with the ensemble tier
+and the spec frontend (bitwise vs the sequential composition), the
+wire-bytes-scored decomposition planners (`plane_wire_bytes`,
+`dims_create` tie-break, `plan_dims` + its `dims_planned` telemetry),
+and the `IGG_OVERLAP` knob's typed parsing / resolution order."""
+
+import numpy as np
+import pytest
+
+import igg
+from igg import GridError
+from igg import telemetry as tel
+from igg.fleet import plan_dims
+from igg.topology import dims_create, plane_wire_bytes
+from helpers import ensemble_states
+
+
+def _stencil(A):
+    """Radius-1 slice-based stencil (accepts any extent, writes its full
+    shape) — the `hide_communication` contract shape from
+    tests/test_overlap.py."""
+    out = 0.1 * A
+    for d in range(A.ndim):
+        lo = [slice(None)] * A.ndim
+        hi = [slice(None)] * A.ndim
+        mid = [slice(None)] * A.ndim
+        lo[d], hi[d], mid[d] = slice(0, -2), slice(2, None), slice(1, -1)
+        out = out.at[tuple(mid)].add(0.15 * (A[tuple(lo)] + A[tuple(hi)]))
+    return out
+
+
+def _seq_member_step(st):
+    return {"T": igg.update_halo_local(_stencil(st["T"]))}
+
+
+def _ov_member_step(st):
+    return {"T": igg.hide_communication(st["T"], _stencil)}
+
+
+# ---------------------------------------------------------------------------
+# hide_communication composed with run_ensemble (both packings)
+# ---------------------------------------------------------------------------
+
+def test_overlap_in_ensemble_grid_packing(eight_devices):
+    """The overlapped member step serves bitwise-identical ensemble state
+    under grid packing — hide_communication composes with the vmapped
+    member axis inside one shard_map program."""
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1,
+                         quiet=True)                     # (2,2,2) mesh
+    kw = dict(watch_every=0, install_sigterm=False, packing="grid")
+    a = igg.run_ensemble(_seq_member_step, ensemble_states(3), 6, **kw)
+    b = igg.run_ensemble(_ov_member_step, ensemble_states(3), 6, **kw)
+    assert a.packing == b.packing == "grid"
+    np.testing.assert_array_equal(np.asarray(a.state["T"]),
+                                  np.asarray(b.state["T"]))
+
+
+def test_overlap_in_ensemble_batch_packing(eight_devices):
+    """Same contract under batch packing (dims=(1,1,1) grid, members on
+    the batch axis): the exchange degenerates to local plane copies and
+    the overlapped restructuring must still be value-identical."""
+    igg.init_global_grid(6, 6, 6, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    kw = dict(watch_every=0, install_sigterm=False, packing="batch")
+    a = igg.run_ensemble(_seq_member_step, ensemble_states(8), 6, **kw)
+    b = igg.run_ensemble(_ov_member_step, ensemble_states(8), 6, **kw)
+    assert a.packing == b.packing == "batch"
+    np.testing.assert_array_equal(np.asarray(a.state["T"]),
+                                  np.asarray(b.state["T"]))
+
+
+# ---------------------------------------------------------------------------
+# Spec-compiled steps: overlap=True bitwise vs the sequential composition
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0), (1, 0, 1)])
+def test_spec_compiled_overlap_matches_sequential(eight_devices, periods):
+    """`igg.stencil.compile(..., overlap=True)` (admission via the
+    analyzer's read-set radius) is bitwise the overlap=False compilation
+    on periodic, open, and mixed 8-device meshes."""
+    from igg import stencil
+
+    igg.init_global_grid(6, 6, 6, periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    T = stencil.Field("T", stagger=(0, 0, 0))
+    r = stencil.Param("r", default=0.1)
+    lap = (T[-1, 0, 0] + T[1, 0, 0] + T[0, -1, 0] + T[0, 1, 0]
+           + T[0, 0, -1] + T[0, 0, 1] - 6.0 * T[0, 0, 0])
+    spec = stencil.StencilSpec(
+        "relax3d", fields=[T], params=[r],
+        updates=[stencil.Update(T, r * lap, pad=((1, 1),) * 3)])
+
+    # Float64 (the suite default): bitwise across every boundary mix.
+    # In float32, XLA's contraction choices may differ between the slab
+    # and full-domain compilations of the same expression, leaving
+    # 1-ulp differences on exchanged planes — the value contract there
+    # is allclose (tests/test_overlap.py), not bitwise.
+    rng = np.random.default_rng(7)
+    A0 = igg.update_halo(igg.from_local_blocks(
+        lambda c, ls: rng.standard_normal(ls), (6, 6, 6),
+        dtype=np.float64))
+    s_seq = stencil.compile(spec, donate=False, n_inner=4,
+                            use_pallas=False, chunk=False, overlap=False)
+    s_ov = stencil.compile(spec, donate=False, n_inner=4,
+                           use_pallas=False, chunk=False, overlap=True)
+    (a,) = s_seq(A0)
+    (b,) = s_ov(A0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# The wire-bytes model and the planner tie-breaks
+# ---------------------------------------------------------------------------
+
+def test_plane_wire_bytes_model():
+    # (2,1,1) over (4,8,64) blocks: one split dim, 2 planes of
+    # elems//local[0] = 512 cells, itemsize 8, nprocs 2.
+    assert plane_wire_bytes((2, 1, 1), (4, 8, 64)) == 2 * 512 * 8 * 2
+    # Unsplit mesh exchanges nothing over the wire.
+    assert plane_wire_bytes((1, 1, 1), (4, 8, 64)) == 0
+    # nfields scales linearly (the grouped-exchange accounting).
+    assert (plane_wire_bytes((2, 2, 1), (8, 8, 8), nfields=3)
+            == 3 * plane_wire_bytes((2, 2, 1), (8, 8, 8)))
+
+
+def test_dims_create_tie_break_minimizes_wire_bytes():
+    """Among permutations of the same balanced slot multiset, the one
+    with the smallest predicted wire plane bytes for the job's local
+    block wins; isotropic blocks keep the MPI_Dims_create order."""
+    # Pancake block (4,4,256): the z planes are 16 cells vs 1024 for
+    # x/y, so the split lands on z.
+    assert dims_create(2, (0, 0, 0), local_shape=(4, 4, 256)) == (1, 1, 2)
+    # Without the local shape: plain MPI_Dims_create non-increasing.
+    assert dims_create(2, (0, 0, 0)) == (2, 1, 1)
+    # Slots (2,2,1): the unsplit slot goes to the big-plane y axis
+    # ((2,1,2) and (1,2,2) tie on bytes; reverse-lex keeps (2,1,2)).
+    assert dims_create(4, (0, 0, 0), local_shape=(4, 4, 256)) == (2, 1, 2)
+    # The chosen permutation really is a bytes-model argmin.
+    import itertools
+    chosen = dims_create(4, (0, 0, 0), local_shape=(4, 4, 256))
+    best = min(plane_wire_bytes(p, (4, 4, 256))
+               for p in set(itertools.permutations((2, 2, 1))))
+    assert plane_wire_bytes(chosen, (4, 4, 256)) == best
+    # Isotropic block: unchanged.
+    assert dims_create(8, (0, 0, 0), local_shape=(16, 16, 16)) == (2, 2, 2)
+    # Fixed entries are never touched: only the free slots permute
+    # (z pinned to 2; the unsplit free slot lands on the big-plane y).
+    assert (dims_create(4, (0, 0, 2), local_shape=(4, 256, 4))
+            == (1, 2, 2))
+
+
+def test_plan_dims_tie_break_and_telemetry():
+    """Equal-balance factor triples are tie-broken by the wire-bytes
+    score, balance stays PRIMARY, and the chosen mapping is logged as a
+    `dims_planned` record carrying the per-link traffic."""
+    # (8,8,64) periodic on 2 devices: (2,1,1)/(1,2,1)/(1,1,2) are all
+    # balance-1; splitting z moves 2048 B/exchange vs 21120 for x/y.
+    dims, local = plan_dims((8, 8, 64), 2)
+    assert dims == (1, 1, 2) and local == (10, 10, 34)
+    candidates = {(2, 1, 1): (6, 10, 66), (1, 2, 1): (10, 6, 66),
+                  (1, 1, 2): (10, 10, 34)}
+    assert (plane_wire_bytes(dims, local)
+            == min(plane_wire_bytes(d, l) for d, l in candidates.items()))
+    rec = [r for r in tel.flight_recorder()
+           if r.kind == "dims_planned"][-1]
+    assert rec.payload["dims"] == [1, 1, 2]
+    assert rec.payload["candidates"] == 3
+    assert rec.payload["hop_cost"] == "uniform"       # CPU: no coords
+    (link,) = rec.payload["per_link"]
+    assert link["dim"] == "z" and link["devices"] == 2
+    assert link["wire_bytes_per_exchange"] == plane_wire_bytes(dims, local)
+    assert link["mean_link_hops"] == 1.0
+
+    # Balance stays primary: (4,2,1) would move fewer wire bytes than
+    # (2,2,2) on an (8,8,8) interior (fewer split dims), but the
+    # MPI_Dims_create balance contract wins.
+    dims, local = plan_dims((8, 8, 8), 8)
+    assert dims == (2, 2, 2)
+    assert (plane_wire_bytes((4, 2, 1), (4, 6, 10))
+            < plane_wire_bytes((2, 2, 2), (6, 6, 6)))
+
+
+# ---------------------------------------------------------------------------
+# IGG_OVERLAP: typed parsing + the resolve_overlap order
+# ---------------------------------------------------------------------------
+
+def test_igg_overlap_flag_parsing(monkeypatch):
+    from igg import _env
+
+    for v in ("1", "true", "YES", "on"):
+        monkeypatch.setenv("IGG_OVERLAP", v)
+        assert _env.flag("IGG_OVERLAP") is True, v
+    for v in ("0", "false", "no", "OFF", ""):
+        monkeypatch.setenv("IGG_OVERLAP", v)
+        assert _env.flag("IGG_OVERLAP") is False, v
+    monkeypatch.setenv("IGG_OVERLAP", "maybe")
+    with pytest.raises(GridError, match="IGG_OVERLAP"):
+        _env.flag("IGG_OVERLAP")
+    monkeypatch.delenv("IGG_OVERLAP")
+    assert _env.flag("IGG_OVERLAP") is False
+    assert _env.flag("IGG_OVERLAP", default=True) is True
+    assert "IGG_OVERLAP" in _env._KNOWN     # registered: no typo warning
+
+
+def test_resolve_overlap_env_overrides_tuned(monkeypatch, eight_devices):
+    from igg.overlap import resolve_overlap
+
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    monkeypatch.delenv("IGG_OVERLAP", raising=False)
+    # No winner, no env: sequential stays the default.
+    assert resolve_overlap("auto", family="diffusion3d") is False
+    # The cached winner's overlap axis serves.
+    assert resolve_overlap("auto", family="diffusion3d",
+                           tuned={"overlap": True}) is True
+    # A set IGG_OVERLAP beats the winner in BOTH directions.
+    monkeypatch.setenv("IGG_OVERLAP", "0")
+    assert resolve_overlap("auto", family="diffusion3d",
+                           tuned={"overlap": True}) is False
+    monkeypatch.setenv("IGG_OVERLAP", "1")
+    assert resolve_overlap("auto", family="diffusion3d",
+                           tuned={"overlap": False}) is True
+    # Admission still gates a forced True: radius beyond ol-1 degrades
+    # to the sequential composition (logged, never raising).
+    assert resolve_overlap("auto", family="diffusion3d",
+                           radius=5) is False
+    assert "radius 5" in igg.degrade.admission_log()["diffusion3d.overlap"]
+    # Explicit caller pins bypass resolution entirely.
+    assert resolve_overlap(True, family="diffusion3d") is True
+    assert resolve_overlap(False, family="diffusion3d") is False
+    with pytest.raises(GridError, match="overlap"):
+        resolve_overlap("sometimes", family="diffusion3d")
