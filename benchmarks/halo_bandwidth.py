@@ -114,8 +114,14 @@ def main():
     # x64 is enabled only around the f64 measurement: under a global x64
     # flag, pallas BlockSpec index maps trace as i64 and Mosaic rejects
     # them ('func.return (i64, i64)'), breaking the f32/bf16 writer paths.
+    # bf16 on EVERY platform (round 18): the first evidence leg of the
+    # mixed-precision direction (ROADMAP item 5) — a bf16 plane moves
+    # half the wire bytes of f32, so at a bandwidth-bound exchange the
+    # update should cost ~half the time at equal effective GB/s; the
+    # rows below record that, and the contract row at the bottom pins
+    # the halved byte accounting exactly.
     if platform == "cpu":
-        dtypes = (np.float32, np.float16)
+        dtypes = (np.float32, jnp.bfloat16, np.float16)
     else:
         dtypes = (np.float32, jnp.bfloat16, np.float64)
     # `xyz_open` (round 6): every dim non-periodic — the reference's
@@ -243,6 +249,37 @@ def main():
                     "igg_halo_plane_bytes_total by exactly the analytic "
                     "plane-bytes model (per (dim, mode) accounting "
                     "reconciles)",
+    })
+
+    # bf16 wire-bytes contract (round 18): the SAME exchange in bf16
+    # must advance the plane-bytes counter by exactly HALF the f32
+    # model — the mixed-precision direction's accounting leg
+    # (itemsize-proportional, deterministic host arithmetic).  The
+    # measured exchange also lands in the comm ledger so the bf16 GB/s
+    # gauges sit next to the f32 ones in one store.
+    bfields = tuple(igg.zeros((n, n, n), dtype=jnp.bfloat16) + i
+                    for i in range(2))
+    before = counter_total()
+    igg.update_halo(*bfields)
+    bdelta = counter_total() - before
+    bmodel, _ = igg.comm.plane_bytes_model((n, n, n), jnp.bfloat16,
+                                           nfields=2)
+    bmis = abs(bdelta - bmodel) / max(bmodel, 1)
+    ratio = model / max(bmodel, 1)
+    emit({
+        "metric": "halo_bytes_bf16_halving_check",
+        "value": round(bmis, 6),
+        "unit": "relative error (bf16 plane-bytes counter vs model)",
+        "config": {"local": n, "fields": 2, "dtype": "bfloat16",
+                   "devices": grid.nprocs, "dims": list(grid.dims),
+                   "platform": platform},
+        "counter_bytes": bdelta,
+        "model_bytes": bmodel,
+        "f32_over_bf16_bytes": ratio,
+        "pass": bool(bmis == 0.0 and ratio == 2.0),
+        "contract": "a bf16 grouped update_halo moves exactly half the "
+                    "f32 wire bytes (itemsize-proportional plane-bytes "
+                    "model, counter reconciles)",
     })
     igg.finalize_global_grid()
 

@@ -310,17 +310,24 @@ def main():
     # by tests/test_mega_tpu.py on hardware.
     from igg.models import hm3d as _hm
 
-    # Pin the (8,1,1) ring (the tests' K=4 config): automatic dims pick
-    # (2,2,2) here, whose y-extension E=4 trips the sublane-tile gate
-    # and crashed the required-tier dispatch below.
-    igg.init_global_grid(16, 16, 128, dimx=8, dimy=1, dimz=1,
-                         quiet=True)   # all dims open
+    # Automatic dims (no more (8,1,1) pin): the sublane-tile-extension
+    # refusal is a structured Admission reason now, so the smoke row
+    # picks the depth the live mesh admits instead of crashing on a
+    # hard-coded one — (2,2,2)'s y-extension needs E % 8 == 0, which
+    # K=8 satisfies (`fit_hm3d_K` finds it; `chunk_engine.
+    # admit_sublane_extension` refuses K=4 with the structured reason).
+    igg.init_global_grid(16, 16, 128, quiet=True)   # all dims open
+    from igg.ops.hm3d_trapezoid import fit_hm3d_K as _hfit
+
+    hgrid = igg.get_global_grid()
+    hK = _hfit(hgrid, (16, 16, 128), 8, np.float32, interpret=True)
+    assert hK, "no hm3d chunk depth admissible on the auto mesh"
     hp = _hm.Params(lx=4.0, ly=4.0, lz=4.0)
     hPe, hphi = _hm.init_fields(hp, dtype=np.float32)
-    n5 = 5   # warm-up + one K=4 chunk
-    href = _hm.make_step(hp, donate=False, n_inner=n5, use_pallas=False)
-    htrap = _hm.make_step(hp, donate=False, n_inner=n5, use_pallas=True,
-                          pallas_interpret=True, trapezoid=True, K=4)
+    hn = hK + 1   # warm-up + one K-deep chunk
+    href = _hm.make_step(hp, donate=False, n_inner=hn, use_pallas=False)
+    htrap = _hm.make_step(hp, donate=False, n_inner=hn, use_pallas=True,
+                          pallas_interpret=True, trapezoid=True, K=hK)
     hr = href(hPe, hphi)
     ht = htrap(hPe, hphi)
     hrel = max(
@@ -331,10 +338,39 @@ def main():
                         n1=2, n2=4)
     emit({
         "metric": "pallas_sweep_ms_per_step",
-        "config": "hm3d_trapezoid_open_interpret_K4", "local": 16,
-        "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+        "config": f"hm3d_trapezoid_open_interpret_K{hK}", "local": 16,
+        "value": round(sec / hn * 1e3, 4), "unit": "ms",
         "platform": platform, "rel_vs_composition": hrel,
         "pass": bool(hrel < 1e-4),
+    })
+    igg.finalize_global_grid()
+
+    # The STREAMING banded rung (this round): diffusion's banded chunk
+    # realization vs the XLA composition, a CONTRACT row on EVERY
+    # platform (the rolling-window/ping-pong structure the compiled
+    # Mosaic kernel streams; interpret shares admission and schedule).
+    igg.init_global_grid(16, 16, 128, periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    n5 = 5   # warm-up + one K=4 chunk
+    dref = d3.make_multi_step(n5, params, donate=False, use_pallas=False,
+                              tune=False)
+    dband = d3.make_multi_step(n5, params, donate=False, banded=True,
+                               K=4, band=8, pallas_interpret=True,
+                               tune=False)
+    dT, dCp = d3.init_fields(params, dtype=np.float32)
+    dr = dref(dT, dCp)
+    db = dband(dT, dCp)
+    drel = float(abs(np.asarray(dr, np.float64)
+                     - np.asarray(db, np.float64)).max()
+                 / (abs(np.asarray(dr, np.float64)).max() + 1e-30))
+    _, sec = time_steps(lambda T, Cp: (dband(T, Cp), Cp), (dT, dCp),
+                        n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "diffusion_banded_interpret_K4", "local": 16,
+        "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+        "platform": platform, "rel_vs_composition": drel,
+        "pass": bool(drel < 1e-4),
     })
     igg.finalize_global_grid()
 
@@ -396,6 +432,27 @@ def main():
         "value": round(sec / n5 * 1e3, 4), "unit": "ms",
         "platform": platform, "rel_vs_hand_composition": srel,
         "pass": bool(srel < 1e-4),
+    })
+
+    # The spec-lowered STREAMING banded rung (this round): same oracle
+    # (the hand-written module's composition), `banded=True` pinning the
+    # `wave2d.banded` tier through the generated ladder.
+    sbstep = _st.compile(_st.wave2d_spec(), coeffs=_st.wave2d_coeffs(wp),
+                         donate=False, n_inner=n5, use_pallas=True,
+                         pallas_interpret=True, banded=True, K=4, band=8)
+    sbo = sbstep(wP, wVx, wVy)
+    sbrel = max(
+        float(abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))
+              .max() / (abs(np.asarray(a, np.float64)).max() + 1e-30))
+        for a, b in zip(wref, sbo))
+    _, sec = time_steps(lambda P, Vx, Vy: sbstep(P, Vx, Vy),
+                        (wP, wVx, wVy), n1=2, n2=4)
+    emit({
+        "metric": "pallas_sweep_ms_per_step",
+        "config": "stencil_wave2d_banded_interpret_K4", "local": 16,
+        "value": round(sec / n5 * 1e3, 4), "unit": "ms",
+        "platform": platform, "rel_vs_hand_composition": sbrel,
+        "pass": bool(sbrel < 1e-4),
     })
 
     sp = _sw.Params()

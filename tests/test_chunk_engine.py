@@ -365,6 +365,235 @@ def test_use_pallas_false_pins_xla_past_the_chunk_tiers():
     assert igg.degrade.active().get("wave2d") == "wave2d.xla"
 
 
+# ---------------------------------------------------------------------------
+# Streaming banded tier (round 18): the rung below the resident chunk
+# tiers — K iterations over the 2K-extended block swept in x-row bands
+# through a rolling VMEM window with HBM ping-pong, so admission needs
+# only the band working set, not the whole block resident.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh,periods,K",
+                         [((8, 1, 1), (1, 1, 1), 4),
+                          ((8, 1, 1), (0, 0, 0), 4),
+                          ((2, 2, 2), (0, 1, 0), 8)],
+                         ids=["ring_periodic", "ring_open", "torus_mixed"])
+def test_hm3d_banded_matches_xla(mesh, periods, K):
+    """banded=True pins hm3d.banded past the (admissible) resident
+    trapezoid tier; output matches the XLA composition on periodic,
+    open, and mixed 8-device interpret meshes."""
+    from igg.models import hm3d
+
+    igg.init_global_grid(16, 16, 128, dimx=mesh[0], dimy=mesh[1],
+                         dimz=mesh[2], periodx=periods[0],
+                         periody=periods[1], periodz=periods[2],
+                         quiet=True)
+    p = hm3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    Pe, phi = hm3d.init_fields(p, dtype=np.float32)
+    n_inner = K + 1
+    ref = hm3d.make_step(p, donate=False, n_inner=n_inner,
+                         use_pallas=False)
+    band = hm3d.make_step(p, donate=False, n_inner=n_inner,
+                          pallas_interpret=True, banded=True, K=K, band=8)
+    r = ref(Pe, phi)
+    b = band(Pe, phi)
+    assert igg.degrade.active().get("hm3d") == "hm3d.banded"
+    for name, a, c in zip(("Pe", "phi"), r, b):
+        a, c = (np.asarray(v, np.float64) for v in (a, c))
+        rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-5, (name, rel, mesh, periods)
+    igg.finalize_global_grid()
+
+
+def test_stokes_banded_matches_xla_staggered():
+    """The staggered-shape family (Vx/Vy/Vz each extend one cell along
+    their own axis) through the banded rung on the 8-device overlap-3
+    ring."""
+    from igg.models import stokes3d
+
+    igg.init_global_grid(16, 16, 128, dimx=8, periodx=1, periody=1,
+                         periodz=1, overlapx=3, overlapy=3, overlapz=3,
+                         quiet=True)
+    p = stokes3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    P, Vx, Vy, Vz, Rho = stokes3d.init_fields(p, dtype=np.float32)
+    ref = stokes3d.make_iteration(p, donate=False, n_inner=5,
+                                  use_pallas=False)
+    band = stokes3d.make_iteration(p, donate=False, n_inner=5,
+                                   pallas_interpret=True, banded=True,
+                                   K=4, band=8)
+    r = ref(P, Vx, Vy, Vz, Rho)
+    b = band(P, Vx, Vy, Vz, Rho)
+    assert igg.degrade.active().get("stokes3d") == "stokes3d.banded"
+    for name, a, c in zip(("P", "Vx", "Vy", "Vz"), r, b):
+        a, c = (np.asarray(v, np.float64) for v in (a, c))
+        rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-30)
+        # 5e-4, not the 2e-5 standard: pure f32 reassociation amplified
+        # by the pseudo-transient Gauss-Seidel chain — the same compare
+        # in f64 agrees to <=1.5e-13 (banded-vs-window order effect).
+        assert rel < 5e-4, (name, rel)
+    igg.finalize_global_grid()
+
+
+def test_wave2d_banded_matches_xla():
+    from igg.models import wave2d
+
+    igg.init_global_grid(16, 16, 1, dimx=4, dimy=2, periodx=1, periody=1,
+                         quiet=True)
+    p = wave2d.Params()
+    fields = _wave_fields(p, pre_steps=3)
+    ref = wave2d.make_step(p, donate=False, n_inner=5, use_pallas=False)
+    band = wave2d.make_step(p, donate=False, n_inner=5,
+                            pallas_interpret=True, banded=True, K=4,
+                            band=8)
+    r = ref(*fields)
+    b = band(*fields)
+    assert igg.degrade.active().get("wave2d") == "wave2d.banded"
+    for name, a, c in zip(("P", "Vx", "Vy"), r, b):
+        a, c = (np.asarray(v, np.float64) for v in (a, c))
+        rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-5, (name, rel)
+
+
+def test_diffusion_banded_matches_xla():
+    from igg.models import diffusion3d
+
+    igg.init_global_grid(16, 16, 128, dimx=8, periodx=1, periody=1,
+                         periodz=1, quiet=True)
+    p = diffusion3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    T, Cp = diffusion3d.init_fields(p, dtype=np.float32)
+    ref = diffusion3d.make_multi_step(5, p, donate=False,
+                                      use_pallas=False)
+    band = diffusion3d.make_multi_step(5, p, donate=False,
+                                       pallas_interpret=True, banded=True,
+                                       K=4, band=8)
+    r = ref(T, Cp)
+    b = band(T, Cp)
+    assert igg.degrade.active().get("diffusion3d") == "diffusion3d.banded"
+    a, c = (np.asarray(v, np.float64) for v in (r, b))
+    rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-30)
+    assert rel < 2e-5, rel
+    igg.finalize_global_grid()
+
+
+def test_spec_banded_matches_xla():
+    """The spec-lowered ladder serves `<name>.banded` too — a tier the
+    frontend generates with zero family-specific banded code."""
+    from igg import stencil
+    from igg.models import wave2d
+
+    igg.init_global_grid(16, 16, 1, dimx=4, dimy=2, periodx=1, periody=1,
+                         quiet=True)
+    p = wave2d.Params()
+    spec = stencil.wave2d_spec()
+    cf = stencil.wave2d_coeffs(p)
+    fields = _wave_fields(p, pre_steps=3)
+    ref = stencil.compile(spec, coeffs=cf, donate=False, n_inner=5,
+                          use_pallas=False)
+    band = stencil.compile(spec, coeffs=cf, donate=False, n_inner=5,
+                           pallas_interpret=True, banded=True, K=4,
+                           band=8)
+    r = ref(*fields)
+    b = band(*fields)
+    assert igg.degrade.active().get(spec.name) == spec.name + ".banded"
+    for name, a, c in zip(("P", "Vx", "Vy"), r, b):
+        a, c = (np.asarray(v, np.float64) for v in (a, c))
+        rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-5, (name, rel)
+
+
+def test_banded_admits_where_resident_fit_refuses_256cubed():
+    """The tentpole's admission claim at the headline shape: 256^3 f32
+    single-device, where the resident window's working set (202 MB)
+    exceeds the VMEM budget so `fit_chunk_K` returns 0 — the banded
+    rung's rolling window still fits and admits (K=4, B=8).  Pure host
+    arithmetic; nothing is allocated."""
+    from igg.ops.hm3d_trapezoid import (fit_hm3d_K, fit_hm3d_band,
+                                        hm3d_banded_supported)
+    from igg.ops.stokes_trapezoid import (fit_stokes_K, fit_stokes_band,
+                                          stokes_banded_supported)
+
+    s = (256, 256, 256)
+    igg.init_global_grid(*s, dimx=1, dimy=1, dimz=1, periodx=1,
+                         periody=1, periodz=1, quiet=True)
+    grid = igg.get_global_grid()
+    assert fit_hm3d_K(grid, s, 8, np.float32, interpret=True) == 0
+    adm = hm3d_banded_supported(grid, s, 4, 4, np.float32, B=8,
+                                interpret=True)
+    assert adm, adm.reason
+    assert fit_hm3d_band(grid, s, 4, np.float32, interpret=True) == (4, 8)
+    igg.finalize_global_grid()
+
+    igg.init_global_grid(*s, dimx=1, dimy=1, dimz=1, periodx=1,
+                         periody=1, periodz=1, overlapx=3, overlapy=3,
+                         overlapz=3, quiet=True)
+    grid = igg.get_global_grid()
+    assert fit_stokes_K(grid, s, 8, np.float32, interpret=True) == 0
+    adm = stokes_banded_supported(grid, s, 4, 4, np.float32, B=8,
+                                  interpret=True)
+    assert adm, adm.reason
+    assert fit_stokes_band(grid, s, 4, np.float32,
+                           interpret=True) == (4, 8)
+    igg.finalize_global_grid()
+
+
+def test_banded_serves_on_auto_ladder_when_resident_refused():
+    """The auto ladder falls THROUGH the resident chunk tier to the
+    banded rung when the VMEM budget refuses the resident window (the
+    2 MB cap keeps the resident fit at 0 while the band fit still
+    admits) — the serving half of the admission claim, provable at
+    test shapes."""
+    from igg.models import hm3d
+
+    igg.init_global_grid(16, 16, 128, dimx=8, periodx=1, periody=1,
+                         periodz=1, quiet=True)
+    p = hm3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    Pe, phi = hm3d.init_fields(p, dtype=np.float32)
+    step = hm3d.make_step(p, donate=False, n_inner=5,
+                          pallas_interpret=True)
+    try:
+        _vmem.set_cap_override(2 * 1024 * 1024)
+        step(Pe, phi)
+        assert igg.degrade.active().get("hm3d") == "hm3d.banded"
+    finally:
+        _vmem.set_cap_override(None)
+
+
+def test_banded_true_raises_when_unsupported():
+    """banded=True is a real contract: requirement-string GridError when
+    no (K, B) is admissible (n_inner=2 leaves no room for a chunk)."""
+    from igg.models import hm3d
+
+    igg.init_global_grid(16, 16, 128, dimx=8, periodx=1, periody=1,
+                         periodz=1, quiet=True)
+    p = hm3d.Params(lx=4.0, ly=4.0, lz=4.0)
+    Pe, phi = hm3d.init_fields(p, dtype=np.float32)
+    step = hm3d.make_step(p, donate=False, n_inner=2,
+                          pallas_interpret=True, banded=True)
+    with pytest.raises(igg.GridError, match="banded"):
+        step(Pe, phi)
+    with pytest.raises(igg.GridError, match="banded"):
+        hm3d.make_step(p, donate=False, n_inner=5, use_pallas=False,
+                       banded=True)
+
+
+def test_resolve_band_rules():
+    """The shared (K, B) resolution: explicit pins hard-refuse, cached
+    values fall back to the fit."""
+    from igg.models._dispatch import resolve_band
+
+    sup = lambda K, B: K == 4 and B == 8
+    fit = lambda bands: (4, 8) if 8 in bands else None
+    # Explicit admissible pair serves; inadmissible explicit pair is a
+    # hard refusal (None), NOT a silent fallback.
+    assert resolve_band(4, 8, False, sup, fit) == (4, 8)
+    assert resolve_band(8, 8, False, sup, fit) is None
+    assert resolve_band(4, 16, False, sup, fit) is None
+    # Cache-sourced values fall back to the auto-fit instead.
+    assert resolve_band(8, 8, True, sup, fit) == (4, 8)
+    assert resolve_band(None, 16, True, sup, fit) == (4, 8)
+    # No K: fit over the band space.
+    assert resolve_band(None, None, False, sup, fit) == (4, 8)
+
+
 def test_explicit_chunk_true_outranks_cached_xla_winner(tmp_path,
                                                         monkeypatch):
     """A cached '<family>.xla' winner must not turn an explicit
