@@ -36,7 +36,7 @@ GOLDENS = HERE / "goldens"
 # (generous, CPU-noise-sized) tolerance.  TPU evidence is never gated
 # against these: compare skips rows whose provenance
 # (backend, device_kind, smoke) does not match.
-GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput",
+GOLDEN_TAGS = ("resilience_overhead", "fleet_throughput", "fleet_churn",
                "halo_bandwidth", "overlap_study", "pallas_sweep",
                "weak_scaling_mesh8")
 # Tags whose goldens keep ONLY the contract rows (lines carrying a
@@ -146,6 +146,14 @@ def main():
     # (every job done, zero quarantines) is asserted by ci.sh.
     r("fleet_throughput.py", [] if not quick else [20, 2, 2, 20],
       tag="fleet_throughput")
+    # Fleet-as-a-service chaos churn: serve_fleet under Poisson arrivals,
+    # a priority preempt, a member NaN, a fenced device, and an arrival
+    # storm — always on the virtual 8-device mesh (it is a robustness
+    # contract, not accelerator evidence; the fence leg needs devices to
+    # fence).  The contract row gates on its "pass" flag; the jobs/hour
+    # and p99-turnaround values are informational (load-shaped).
+    r("fleet_throughput.py", ["--churn", 16, 5, 2, 20], virtual=8,
+      tag="fleet_churn")
     # Multi-device program structure on a virtual 8-device CPU mesh (the
     # environment-portable analog of the 2x2x2 BASELINE config).  64^3 for
     # weak scaling = compute-dominated (see benchmarks/README.md for how to

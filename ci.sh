@@ -135,6 +135,45 @@ else
     exit 1
 fi
 
+# Round 20: the fleet-as-a-service tier.  The chaos-churn contract row
+# (serve_fleet under Poisson arrivals + a priority preempt + a member
+# NaN + a fenced device + an arrival storm: every admitted job done,
+# zero quarantined members, the storm shed, jobs/hour + p99 turnaround
+# journal-derived and finite) runs on the virtual 8-device mesh and is
+# golden-gated via benchmarks/goldens/fleet_churn.jsonl in the run_all
+# --compare above.
+if grep '"metric": "fleet_churn"' \
+        benchmarks/results_smoke/fleet_churn.jsonl \
+        | grep -q '"pass": true'; then
+    echo "    fleet_churn smoke contract row PRESENT and passing"
+    echo "    (fleet_churn.jsonl)"
+else
+    echo "    fleet_churn smoke contract row MISSING or failed"
+    echo "    (benchmarks/results_smoke/fleet_churn.jsonl)"
+    exit 1
+fi
+
+# Round 20: the churn golden must BITE — a flipped fleet_churn contract
+# pass flag against the committed golden has to fail the gate (the
+# run_all --compare above proves the green path; this proves the red
+# one, same pattern as the round-14 comm golden proof).
+echo "=== fleet-churn golden-gate proof (flipped contract pass flag must"
+echo "    fail igg.perf compare) ==="
+IGG_CHURN_GATE_TMP=$(mktemp -d)
+sed 's/"pass": true/"pass": false/' benchmarks/goldens/fleet_churn.jsonl \
+    > "$IGG_CHURN_GATE_TMP/new.jsonl"
+if python -m igg.perf compare benchmarks/goldens/fleet_churn.jsonl \
+        "$IGG_CHURN_GATE_TMP/new.jsonl" --tol 3.0; then
+    echo "    fleet-churn golden gate FAILED to flag the flipped"
+    echo "    contract row"
+    rm -rf "$IGG_CHURN_GATE_TMP"
+    exit 1
+else
+    echo "    fleet-churn golden gate correctly rejected the flipped"
+    echo "    contract row"
+fi
+rm -rf "$IGG_CHURN_GATE_TMP"
+
 # Round 12: the unified observability subsystem.  With an igg.telemetry
 # session attached, run_resilient's hot loop pays one step_stats record +
 # JSONL line per watch window and one counter increment per step — the
@@ -336,6 +375,22 @@ echo "    recovery -> job preempt -> journal -> elastic resume on 4 of 8"
 echo "    devices, bit-identical to the uninterrupted fleet) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/fleet_run.py
+
+# Round 20: fleet as a service end to end.  The scheduler loop owns the
+# main thread while a driver thread plays two tenants over the REAL
+# POST /jobs intake: online admission while a job runs, a priority-5
+# arrival preempting the running job, an arrival storm + malformed body
+# shed/rejected at the bounded queues (a late POST observes HTTP 429
+# queue_saturated and /healthz pins the 503 readiness reason), a REAL
+# SIGTERM drains to sealed generations + a sealed journal, and a
+# resume=True relaunch re-admits everything from the journaled specs
+# and finishes BIT-EXACT to an uninterrupted fleet — with the whole
+# timeline order-asserted from the journal + events JSONL alone.
+echo "=== fleet service end to end (POST /jobs two tenants -> priority"
+echo "    preempt -> storm shed 429 -> SIGTERM drain -> resume bit-exact;"
+echo "    timeline from journal + events JSONL; 8-device CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/fleet_service.py
 
 echo "=== observability end to end (chaos NaN-corrupt kernel -> watchdog ->"
 echo "    rollback -> tier demotion, full timeline reconstructed from the"

@@ -1,0 +1,350 @@
+"""Fleet as a service end to end: online multi-tenant submission →
+backpressure shedding → priority preemption → SIGTERM drain → bit-exact
+resume — with the whole episode reconstructed from the journal + events
+JSONL alone.
+
+What `igg.serve_fleet` gives an always-on sweep service, demonstrated
+with the real HTTP intake and the deterministic submission-chaos
+injectors (the same harness `tests/test_serve.py` drives):
+
+1. the scheduler loop owns the MAIN thread (so `install_sigterm=True`
+   works) while a driver thread plays two tenants: alice POSTs a long
+   base job to `POST /jobs` on the statusd endpoint, bob POSTs two small
+   jobs while alice's is running — all landing in the shared
+   `igg-fleet-journal-v1` journal;
+2. alice POSTs a priority-5 job that cannot be placed: the scheduler
+   preempts her running priority-0 job through its per-job preemption
+   cell (final ring generation sealed, `job_requeued` with reason
+   "priority"), and the hot job launches in its place;
+3. `igg.chaos.arrival_storm` fires 8 arrivals from a "load" tenant in
+   one scheduler tick plus one malformed body: the bounded queues admit
+   to their bounds and SHED the rest (429 + `job_shed` events), the
+   malformed body is rejected at the door, and a late POST from bob
+   observes HTTP 429 `queue_saturated` while `/healthz` reports 503
+   with the pinned `queue_saturated` readiness reason;
+4. SIGTERM (the real signal, delivered to the process) starts the
+   graceful drain: intake stops, the running job seals its generation,
+   the journal seals, and `serve_fleet` returns `drained=True` with
+   every queued submission still journaled;
+5. a `resume=True` relaunch re-admits everything from the journaled
+   specs (no submitting client involved), finishes every job, and the
+   preempted-twice alice jobs are BIT-IDENTICAL to an uninterrupted
+   `run_fleet` of the same configs — asserted at the end;
+6. the timeline (admit → preempt → shed → drain → resume → done) is
+   reconstructed and order-asserted from the two artifacts alone: the
+   journal and the telemetry events JSONL.
+
+Run on TPU or the virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/fleet_service.py
+"""
+
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import igg
+from igg.ops import interior_add
+
+
+def member_step(st):
+    T = st["T"]
+    lap = (T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+           + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+           + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+           - 6.0 * T[1:-1, 1:-1, 1:-1])
+    return {"T": igg.update_halo_local(interior_add(T, 0.1 * lap))}
+
+
+def make_states(seed, members):
+    """Decomposition-INVARIANT member states (wrap-indexed global random
+    field), so elastic resume on any subset compares bit-exact."""
+    def build(grid):
+        rng = np.random.default_rng(seed)
+        g = [grid.dims[d] * (grid.nxyz[d] - grid.overlaps[d])
+             for d in range(3)]
+        out = []
+        for _ in range(members):
+            glob = rng.standard_normal(g)
+
+            def block(coords, ls, glob=glob):
+                idx = [(coords[d] * (ls[d] - grid.overlaps[d])
+                        + np.arange(ls[d])) % g[d] for d in range(3)]
+                return glob[np.ix_(*idx)]
+
+            T = igg.from_local_blocks(block, tuple(grid.nxyz))
+            out.append({"T": igg.update_halo(T)})
+        return out
+    return build
+
+
+def job_factory(spec):
+    """The host-side hook: a validated JSON spec becomes a runnable
+    igg.Job (specs cannot carry callables across HTTP — the factory
+    binds the physics)."""
+    return igg.Job(
+        name=spec["name"], global_interior=tuple(spec["global_interior"]),
+        members=spec["members"], n_steps=spec["n_steps"],
+        make_states=make_states(spec.get("seed", 0), spec["members"]),
+        step_fn=member_step, watch_every=50,
+        checkpoint_every=int(spec.get("checkpoint_every", 500)))
+
+
+def _post(url, spec):
+    data = spec if isinstance(spec, bytes) else json.dumps(spec).encode()
+    req = urllib.request.Request(url + "/jobs", data=data, method="POST",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _wait(pred, timeout=60, poll=0.05, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _spec(name, tenant, *, n_steps, seed=0, priority=0, n_devices=None):
+    s = {"name": name, "tenant": tenant, "global_interior": [8, 8, 8],
+         "members": 2, "n_steps": n_steps, "seed": seed,
+         "priority": priority, "submit_token": f"tok-{name}"}
+    if n_devices is not None:
+        s["n_devices"] = n_devices
+    return s
+
+
+def _final_interiors(ring_dir, members=2):
+    """Each member's interior from a ring's newest generation, restored
+    onto a canonical (2,2,2) grid (decomposition-independent compare)."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    out = igg.load_checkpoint(igg.latest_checkpoint(ring_dir, "ens"),
+                              redistribute=True)
+    T = out["T"]
+    got = np.stack([np.asarray(igg.gather_interior(T[..., m]))
+                    for m in range(members)])
+    igg.finalize_global_grid()
+    return got
+
+
+def drive(url, ctl, events, fail):
+    """The client side, on its own thread (the scheduler loop owns the
+    main thread so the REAL SIGTERM handler can run there)."""
+    try:
+        ctl.wait_ready(30)
+
+        def kinds(kind, **match):
+            return [e for e in list(events) if e.kind == kind
+                    and all(e.detail.get(k) == v
+                            for k, v in match.items())]
+
+        # -- two tenants submit over HTTP while one runs ------------------
+        code, doc = _post(url, _spec("alice-base", "alice", n_steps=4000,
+                                     seed=11, n_devices=8))
+        assert (code, doc["status"]) == (201, "admitted"), (code, doc)
+        _wait(lambda: "alice-base" in ctl.stats()["running"],
+              what="alice-base running")
+        print("  alice-base: admitted over POST /jobs, running on all 8 "
+              "devices")
+        for name in ("bob-a", "bob-b"):
+            code, doc = _post(url, _spec(name, "bob", n_steps=20, seed=3))
+            assert code == 201, (code, doc)
+        assert ctl.stats()["tenants"]["bob"]["queued"] == 2
+        print("  bob-a, bob-b: admitted while alice's job runs (queued — "
+              "no free devices)")
+
+        # -- priority preemption ------------------------------------------
+        code, doc = _post(url, _spec("alice-hot", "alice", n_steps=4000,
+                                     seed=22, priority=5, n_devices=8))
+        assert code == 201, (code, doc)
+        _wait(lambda: kinds("job_requeued", job="alice-base",
+                            reason="priority"),
+              what="priority preemption of alice-base")
+        _wait(lambda: ctl.stats()["running"] == ["alice-hot"],
+              what="alice-hot running")
+        print("  alice-hot (priority 5): preempted alice-base (sealed "
+              "ring generation, requeued) and took its devices")
+
+        # -- arrival storm + malformed body: bounded admission ------------
+        assert ctl.stats()["queue_depth"] == 3
+        with igg.chaos.armed(igg.chaos.arrival_storm(8, tenant="load"),
+                             igg.chaos.malformed_submission(1)):
+            _wait(lambda: (len(kinds("job_admitted", source="storm"))
+                           + len(kinds("job_shed", tenant="load"))) == 8
+                  and kinds("job_rejected", source="chaos"),
+                  what="storm + malformed accounted")
+        admitted = len(kinds("job_admitted", source="storm"))
+        shed = len(kinds("job_shed", tenant="load"))
+        assert (admitted, shed) == (3, 5), (admitted, shed)
+        print(f"  arrival storm (8 jobs, tenant 'load'): {admitted} "
+              f"admitted to the bounds, {shed} SHED (429 + job_shed); "
+              f"malformed body rejected at the door")
+
+        # -- backpressure observed by a real client + readiness pin -------
+        code, doc = _post(url, _spec("bob-late", "bob", n_steps=20))
+        assert (code, doc.get("reason")) == (429, "queue_saturated"), (
+            code, doc)
+        code, body = _get(url, "/healthz")
+        assert code == 503 and "queue_saturated" in body, (code, body)
+        code, body = _get(url, "/status")
+        serve = json.loads(body)["serve"]
+        assert serve["saturated"] and set(serve["tenants"]) >= {
+            "alice", "bob", "load"}
+        print("  bob's late POST: HTTP 429 queue_saturated; /healthz 503 "
+              "with the pinned queue_saturated readiness reason")
+
+        # -- graceful shutdown: the real signal ---------------------------
+        os.kill(os.getpid(), signal.SIGTERM)
+        print("  SIGTERM sent: drain protocol starts")
+    except BaseException as e:          # surface on the main thread
+        fail.append(e)
+        try:
+            ctl.drain()
+        except Exception:
+            pass
+
+
+def main():
+    wd = os.path.join(tempfile.gettempdir(), "igg_fleet_service")
+    ref_wd = os.path.join(tempfile.gettempdir(), "igg_fleet_service_ref")
+    tel = os.path.join(wd, "telemetry")
+    for d in (wd, ref_wd):
+        shutil.rmtree(d, ignore_errors=True)
+
+    events, fail = [], []
+    ctl = igg.ServeControl()
+    srv = igg.statusd.StatusServer(port=0)
+    srv.start()
+    print("fleet service up (scheduler on the main thread, statusd on "
+          f"port {srv.port})")
+    t = threading.Thread(target=drive,
+                         args=(f"http://127.0.0.1:{srv.port}", ctl,
+                               events, fail), daemon=True)
+    t.start()
+    try:
+        res = igg.serve_fleet(wd, job_factory, control=ctl, serve=srv,
+                              telemetry=tel, max_concurrent=2,
+                              queue_bound=6, tenant_queue_bound=3,
+                              on_event=events.append,
+                              stop_when_idle_s=60, install_sigterm=True)
+    finally:
+        t.join(timeout=30)
+        srv.stop()
+    if fail:
+        raise fail[0]
+
+    # -- the drain left a resumable journal -------------------------------
+    assert res.drained, "serve loop did not exit through the drain"
+    assert res.jobs["alice-hot"].status == "preempted"
+    journal = json.load(open(os.path.join(wd, "journal.json")))
+    assert "sealed_at" in journal
+    st = {k: v["status"] for k, v in journal["jobs"].items()}
+    assert st["alice-hot"] == "preempted"
+    assert st["alice-base"] == "preempted"
+    assert all(st[n] == "queued"
+               for n in ("bob-a", "bob-b", "storm-load-1", "storm-load-2",
+                         "storm-load-3")), st
+    print("drained: journal sealed with 2 preempted + 5 queued "
+          "submissions, ready for resume")
+
+    # -- resume=True: re-admit everything from the journaled specs --------
+    print("resume=True relaunch (no submitting client — specs come from "
+          "the journal)")
+    events2 = []
+    res2 = igg.serve_fleet(wd, job_factory, resume=True, telemetry=tel,
+                           max_concurrent=2, queue_bound=6,
+                           tenant_queue_bound=3, on_event=events2.append,
+                           stop_when_idle_s=1.5, install_sigterm=False)
+    want = {"alice-base", "alice-hot", "bob-a", "bob-b", "storm-load-1",
+            "storm-load-2", "storm-load-3"}
+    assert set(res2.jobs) == want, set(res2.jobs)
+    assert all(o.status == "done" for o in res2.jobs.values()), {
+        k: v.status for k, v in res2.jobs.items()}
+    resumed = {e.detail.get("job") for e in events2
+               if e.kind == "job_resumed"}
+    assert {"alice-base", "alice-hot"} <= resumed, resumed
+    print(f"  all {len(res2.jobs)} jobs done; alice's preempted jobs "
+          f"resumed elastically from their sealed rings")
+
+    # -- bit-exactness vs an uninterrupted fleet --------------------------
+    print("uninterrupted reference fleet for the bit-exactness oracle")
+    ref_jobs = [igg.Job(name=n, global_interior=(8, 8, 8), members=2,
+                        n_steps=4000, make_states=make_states(s, 2),
+                        step_fn=member_step, watch_every=50,
+                        checkpoint_every=500)
+                for n, s in (("alice-base", 11), ("alice-hot", 22))]
+    ref = igg.run_fleet(ref_jobs, ref_wd, install_sigterm=False)
+    assert all(o.status == "done" for o in ref.jobs.values())
+    for name in ("alice-base", "alice-hot"):
+        got = _final_interiors(os.path.join(wd, "jobs", name))
+        want_T = _final_interiors(os.path.join(ref_wd, "jobs", name))
+        assert np.array_equal(got, want_T), name
+        print(f"  {name}: bit-identical to the uninterrupted run "
+              f"(preempt + drain + resume lost nothing)")
+
+    # -- the timeline from the artifacts alone ----------------------------
+    # Both serve sessions sank their scheduler events into ONE JSONL;
+    # with the journal that is the full story — no in-process state used.
+    recs = [json.loads(l) for l in
+            open(os.path.join(tel, "events_r0.jsonl"))]
+
+    def first(kind, **match):
+        for i, r in enumerate(recs):
+            if r["kind"] == kind and all(
+                    r["payload"].get(k) == v for k, v in match.items()):
+                return i
+        raise AssertionError(f"no {kind} {match} in the events JSONL")
+
+    order = [
+        ("admitted", first("job_admitted", job="alice-base")),
+        ("preempted for priority", first("job_requeued", job="alice-base",
+                                         reason="priority")),
+        ("storm shed", first("job_shed", tenant="load")),
+        ("drain (SIGTERM)", first("drain_started", source="sigterm")),
+        ("session drained", first("run_finished", drained=True)),
+        ("resume re-admit", first("job_admitted", job="alice-hot",
+                                  source="resume")),
+        ("resumed from ring", first("job_resumed", job="alice-hot")),
+        ("done", first("job_done", job="alice-hot")),
+    ]
+    assert [i for _, i in order] == sorted(i for _, i in order), order
+    print("timeline reconstructed from journal + events JSONL alone:")
+    for label, i in order:
+        r = recs[i]
+        print(f"  [{i:4d}] {r['kind']:<14} {label}")
+    final = json.load(open(os.path.join(wd, "journal.json")))
+    assert all(v["status"] == "done" for v in final["jobs"].values())
+
+    for d in (wd, ref_wd):
+        shutil.rmtree(d, ignore_errors=True)
+    print("fleet_service: OK")
+
+
+if __name__ == "__main__":
+    main()

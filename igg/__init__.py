@@ -119,6 +119,7 @@ from .checkpoint import (
 from .resilience import ResilienceError, RunResult, run_resilient
 from .ensemble import EnsembleResult, run_ensemble
 from .fleet import FleetResult, Job, JobOutcome, run_fleet
+from .serve import ServeControl, ServeResult, serve_fleet
 from .timing import time_steps
 from . import autotune
 from . import chaos
@@ -132,6 +133,7 @@ from . import integrity
 from . import perf
 from . import profiling
 from . import resilience
+from . import serve
 from . import statusd
 from . import stencil
 from . import telemetry
@@ -160,6 +162,7 @@ __all__ = [
     "degrade", "vis",
     "run_ensemble", "EnsembleResult", "ensemble",
     "run_fleet", "Job", "JobOutcome", "FleetResult", "fleet",
+    "serve_fleet", "ServeControl", "ServeResult", "serve",
     "telemetry", "Telemetry", "perf", "comm", "heal", "integrity",
     "autotune",
     "statusd", "stencil", "time_steps", "__version__",

@@ -92,6 +92,24 @@ _KNOWN: Dict[str, str] = {
         "rank-tagged automatically on multi-controller runs)",
     "IGG_PERF_SAVE_EVERY":
         "minimum seconds between perf-ledger autosaves (default 60)",
+    "IGG_SERVE_MAX_CONCURRENT":
+        "concurrent jobs the serve scheduler runs on disjoint device "
+        "subsets (default 2; the bin-packer partitions the live devices)",
+    "IGG_SERVE_QUEUE_BOUND":
+        "global admission-queue bound of igg.serve — submissions past it "
+        "shed with 429/job_shed and readiness pins queue_saturated "
+        "(default 16)",
+    "IGG_SERVE_TENANT_QUEUE_BOUND":
+        "per-tenant admission-queue bound of igg.serve (default 8)",
+    "IGG_SERVE_TENANT_RETRIES":
+        "per-tenant retry budget: strikes a tenant's failing jobs may "
+        "burn before its submissions shed and its jobs fail fast "
+        "(default 8)",
+    "IGG_SERVE_POLL":
+        "serve scheduler tick interval in seconds (default 0.05)",
+    "IGG_SERVE_MAX_BODY":
+        "largest accepted submission body in bytes — bigger is rejected "
+        "oversized (default 65536)",
     "IGG_STATUSD_PORT":
         "TCP port of the igg.statusd live ops endpoint (0/unset: off; "
         "the serve= knob on the run loops overrides)",

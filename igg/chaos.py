@@ -962,6 +962,74 @@ def job_preempt_at(job: str, step: int) -> JobChaos:
     return JobChaos("preempt", job, {"step": int(step)})
 
 
+class SubmitChaos:
+    """Armed hostile-intake injection (see :func:`arrival_storm` /
+    :func:`malformed_submission`): appends its entries to the
+    `igg.serve._CHAOS_SUBMIT_TAP` seam on `arm()` and removes exactly
+    them on `disarm()` — the :class:`JobChaos` pattern applied to the
+    service's submission plane.  The scheduler loop consumes entries
+    one-shot at its next tick, so a storm fires once per arming and a
+    drained queue stays drained."""
+
+    def __init__(self, kind: str, entry: dict):
+        self._kind = kind          # "storm" | "malformed"
+        self._entry = entry
+
+    def arm(self) -> "SubmitChaos":
+        from . import serve
+
+        tap = serve._CHAOS_SUBMIT_TAP or {}
+        tap.setdefault(self._kind, []).append(self._entry)
+        serve._CHAOS_SUBMIT_TAP = tap
+        return self
+
+    def disarm(self) -> None:
+        from . import serve
+
+        tap = serve._CHAOS_SUBMIT_TAP
+        if not tap:
+            return
+        entries = tap.get(self._kind)
+        if entries and self._entry in entries:
+            entries.remove(self._entry)
+        if not any(tap.get(k) for k in tap):
+            serve._CHAOS_SUBMIT_TAP = None
+
+    def __enter__(self) -> "SubmitChaos":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def arrival_storm(n: int, tenant: str = "default",
+                  spec: Optional[dict] = None) -> SubmitChaos:
+    """Context manager firing `n` job submissions at the live
+    :func:`igg.serve.serve_fleet` loop in ONE scheduler tick — the
+    thundering-herd arrival shape admission control must shed, not
+    absorb.  Each synthetic submission clones `spec` (a plain job-spec
+    template; default: a minimal 8³ single-member config) under `tenant`
+    with a unique ``storm-{tenant}-{seq}`` name, and runs the FULL
+    admission pipeline: the queue fills to its bound and the rest shed
+    with 429/``job_shed`` (reason ``queue_saturated`` — the statusd
+    readiness reason pins until the drain)::
+
+        with igg.chaos.armed(igg.chaos.arrival_storm(50, tenant="noisy")):
+            ...   # next tick: 50 arrivals, bounded admission, the rest shed
+    """
+    return SubmitChaos("storm", {"n": int(n), "tenant": tenant,
+                                 "spec": dict(spec) if spec else None})
+
+
+def malformed_submission(times: int = 1) -> SubmitChaos:
+    """Context manager injecting `times` MALFORMED submission bodies
+    (truncated JSON) through the serve intake — the hostile-client shape
+    admission must reject at the door (400, a ``job_rejected`` event
+    with the parse reason) without disturbing any queued or running
+    job."""
+    return SubmitChaos("malformed", {"times": int(times)})
+
+
 @contextlib.contextmanager
 def armed(*injectors):
     """Arm several injectors for a scope, disarming ALL of them (reverse

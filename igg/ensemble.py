@@ -79,8 +79,8 @@ from . import shared
 from . import telemetry as _telemetry
 from .shared import AXIS_NAMES, GridError
 from . import resilience as _resilience
-from .resilience import Event, ResilienceError, _is_ready, _preempt, \
-    clear_preemption, request_preemption
+from .resilience import Event, ResilienceError, _is_ready, \
+    clear_preemption, preemption_requested, request_preemption
 
 __all__ = ["run_ensemble", "EnsembleResult", "stack_members",
            "member_state"]
@@ -1042,7 +1042,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
         while True:
             in_catchup = cohort is not None
             front_done = (not in_catchup) and steps_done >= n_steps
-            if front_done or (_preempt.is_set() and not in_catchup):
+            if front_done or (preemption_requested() and not in_catchup):
                 # Tail window: probe the final partial window, drain, and
                 # isolate any straggler blowup before finishing.
                 if (eprobe is not None and pos % watch_every != 0
@@ -1062,7 +1062,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                         None, steps_done)
                     _refresh_mask()
                     continue
-                if _preempt.is_set() and not front_done:
+                if preemption_requested() and not front_done:
                     preempted = True
                 break
 
@@ -1084,7 +1084,7 @@ def run_ensemble(step_fn: Callable[[Dict], Dict], states, n_steps: int, *,
                 # cohort must reach the front first (the loop's exit
                 # condition requires it), else this skip would starve the
                 # replay and spin forever.
-                if _preempt.is_set() and not in_catchup:
+                if preemption_requested() and not in_catchup:
                     continue
 
             _dispatch(mask_dev)

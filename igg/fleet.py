@@ -64,7 +64,8 @@ from .shared import GridError, NDIMS
 from .resilience import Event, ResilienceError, clear_preemption, \
     preemption_requested, preemption_requests, request_preemption
 
-__all__ = ["Job", "JobOutcome", "FleetResult", "run_fleet", "plan_dims"]
+__all__ = ["Job", "JobOutcome", "FleetResult", "run_fleet", "plan_dims",
+           "job_config_hash"]
 
 _JOURNAL = "journal.json"
 _JOURNAL_FORMAT = "igg-fleet-journal-v1"
@@ -75,6 +76,8 @@ _JOURNAL_FORMAT = "igg-fleet-journal-v1"
 _SCHEDULER_KINDS = frozenset({
     "job_started", "job_done", "job_failed", "job_gave_up",
     "job_requeued", "job_preempted", "job_resumed", "heal_repack",
+    "job_name_reused", "job_admitted", "job_shed", "job_rejected",
+    "job_quarantined", "device_fenced",
 })
 
 # Chaos seam (igg.chaos.scheduler_fault / job_preempt_at): a dict
@@ -123,6 +126,17 @@ class Job:
     make_states: Callable = None
     step_fn: Callable = None
     make_step: Callable = None
+    # Multi-tenant service identity (igg.serve): the owning tenant, the
+    # scheduling priority (higher preempts lower), the submission wall
+    # time, an optional queue-residency deadline, and the device-count
+    # request the bin-packing admission honors (None: the scheduler's
+    # default share).  Plain run_fleet drains ignore all but the journal
+    # stamping, so batch queues are unchanged.
+    tenant: str = "default"
+    priority: int = 0
+    submitted_at: Optional[float] = None
+    deadline_s: Optional[float] = None
+    n_devices: Optional[int] = None
     periods: Tuple[int, int, int] = (1, 1, 1)
     overlaps: Tuple[int, int, int] = (2, 2, 2)
     watch_every: int = 10
@@ -306,6 +320,19 @@ def _read_journal(path: pathlib.Path) -> dict:
     return j
 
 
+def job_config_hash(job: "Job") -> str:
+    """Identity stamp of a job's CONFIG (global_interior / members /
+    n_steps / tenant), journaled with every record: resume matches a job
+    against its prior record by this hash, so a NEW job reusing a finished
+    job's name is a fresh job (`job_name_reused`), not a silent skip."""
+    import hashlib
+
+    key = json.dumps([list(int(v) for v in job.global_interior),
+                      int(job.members), int(job.n_steps),
+                      str(job.tenant)])
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
 def _write_journal(path: pathlib.Path, journal: dict) -> None:
     from .checkpoint import _write_atomic_text
 
@@ -315,6 +342,55 @@ def _write_journal(path: pathlib.Path, journal: dict) -> None:
     # resume=True misparses as "everything queued".
     _write_atomic_text(path, json.dumps(journal, indent=1, sort_keys=True),
                        durable=True)
+
+
+def _journal_record(journal: dict, job: Job) -> dict:
+    """The job's journal record, created queued if absent.  Existing
+    records are read ABSENT-KEY-TOLERANTLY: a journal written before the
+    service fields existed (tenant / priority / submitted_at / deadline_s
+    / config_hash) resumes unchanged — missing keys are backfilled from
+    the job without disturbing what the old drain recorded."""
+    rec = journal["jobs"].setdefault(job.name, {
+        "status": "queued", "attempts": 0, "steps_done": 0,
+        "members": job.members, "quarantined": [], "dims": None})
+    rec.setdefault("status", "queued")
+    rec.setdefault("attempts", 0)
+    rec.setdefault("steps_done", 0)
+    rec.setdefault("quarantined", [])
+    rec.setdefault("dims", None)
+    rec.setdefault("tenant", job.tenant)
+    rec.setdefault("priority", int(job.priority))
+    rec.setdefault("submitted_at", job.submitted_at)
+    rec.setdefault("deadline_s", job.deadline_s)
+    rec.setdefault("config_hash", job_config_hash(job))
+    return rec
+
+
+def _reused_name(journal: dict, job: Job) -> bool:
+    """True when `job` reuses the name of a journaled record whose config
+    hash differs — a DIFFERENT job, not a resume target.  Records from
+    pre-hash journals carry no hash and keep the old skip/resume
+    semantics (there is nothing to compare)."""
+    rec = journal["jobs"].get(job.name)
+    if not isinstance(rec, dict):
+        return False
+    stamped = rec.get("config_hash")
+    return stamped is not None and stamped != job_config_hash(job)
+
+
+def _reset_for_reuse(journal: dict, jobdir: pathlib.Path, job: Job,
+                     _emit) -> None:
+    """Make a reused name a FRESH job: warn (`job_name_reused`), drop the
+    stale record, and clear the prior job's checkpoint ring so elastic
+    resume can never mix generations of two different configs."""
+    import shutil
+
+    old = journal["jobs"].pop(job.name, {}) or {}
+    _emit("job_name_reused", 0, job=job.name, tenant=job.tenant,
+          prior_status=old.get("status"),
+          prior_config_hash=old.get("config_hash"),
+          config_hash=job_config_hash(job))
+    shutil.rmtree(jobdir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -458,10 +534,7 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
         return len(jobs) - done
 
     def _jrec(job: Job) -> dict:
-        rec = journal["jobs"].setdefault(job.name, {
-            "status": "queued", "attempts": 0, "steps_done": 0,
-            "members": job.members, "quarantined": [], "dims": None})
-        return rec
+        return _journal_record(journal, job)
 
     def _transition(job: Job, **updates) -> None:
         _jrec(job).update(updates)
@@ -481,6 +554,13 @@ def run_fleet(jobs: Sequence[Job], workdir, *, devices=None,
     m_queue.set(_queue_depth())
     try:
         for job in jobs:
+            if resume and _reused_name(journal, job):
+                # Same name, different config hash: a NEW job, not the
+                # journaled one — never skip it as finished (or resume it
+                # from the other config's ring).
+                _reset_for_reuse(journal, workdir / "jobs" / job.name,
+                                 job, _emit)
+                _write_journal(jpath, journal)
             rec = _jrec(job)
             if resume and rec["status"] == "done":
                 outcomes[job.name] = JobOutcome(

@@ -77,6 +77,7 @@ watchdog adds < 2% over the bare step loop
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import pathlib
 import signal
@@ -131,26 +132,92 @@ _preempt = threading.Event()
 _preempt_requests = 0
 
 
+class PreemptionCell:
+    """A scoped preemption channel: one flag + monotone request count, for
+    ONE job's run loop instead of the whole process.  The scheduler tier
+    (:mod:`igg.serve`) gives each concurrent job a cell and installs it in
+    the job's worker thread via :func:`preemption_scope`, so a priority
+    preempt (or a fenced-device shrink) reaches exactly one job while its
+    neighbors run on.  Thread-safe: the scheduler requests from its own
+    thread, the run loop polls from the worker's."""
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def request(self) -> None:
+        with self._lock:
+            self._count += 1
+        self._ev.set()
+
+    def clear(self) -> None:
+        self._ev.clear()
+
+    def requested(self) -> bool:
+        return self._ev.is_set()
+
+    def requests(self) -> int:
+        with self._lock:
+            return self._count
+
+
+_preempt_tls = threading.local()
+
+
+def _preempt_cell() -> Optional[PreemptionCell]:
+    return getattr(_preempt_tls, "cell", None)
+
+
+@contextlib.contextmanager
+def preemption_scope(cell: PreemptionCell):
+    """Route this thread's ambient preemption verbs through `cell`:
+    :func:`request_preemption` raised FROM this thread (a chaos injector,
+    the heal engine's bus handler) lands on the cell, the poll verbs read
+    the cell OR the process flag (a process-wide request — an operator
+    SIGTERM — still reaches every scoped loop), and
+    :func:`clear_preemption` clears only the cell (the owner rule: a
+    scoped consumer must never swallow a process-wide shutdown)."""
+    prev = _preempt_cell()
+    _preempt_tls.cell = cell
+    try:
+        yield cell
+    finally:
+        _preempt_tls.cell = prev
+
+
 def request_preemption(signum=None, frame=None) -> None:
     """Ask the running :func:`run_resilient` loop to checkpoint and exit at
     the next dispatch boundary.  Signature doubles as a signal handler
-    (`run_resilient` installs it for SIGTERM by default)."""
+    (`run_resilient` installs it for SIGTERM by default).  Inside a
+    :func:`preemption_scope` the request lands on the scope's cell."""
     global _preempt_requests
+    cell = _preempt_cell()
+    if cell is not None:
+        cell.request()
+        return
     _preempt_requests += 1
     _preempt.set()
 
 
 def preemption_requests() -> int:
-    """Monotone count of :func:`request_preemption` calls this process
-    (never reset by :func:`clear_preemption`)."""
-    return _preempt_requests
+    """Monotone count of :func:`request_preemption` calls visible to this
+    thread (never reset by :func:`clear_preemption`): the process-wide
+    count plus — inside a :func:`preemption_scope` — the cell's own."""
+    cell = _preempt_cell()
+    return _preempt_requests + (cell.requests() if cell is not None else 0)
 
 
 def preemption_requested() -> bool:
-    return _preempt.is_set()
+    cell = _preempt_cell()
+    return _preempt.is_set() or (cell is not None and cell.requested())
 
 
 def clear_preemption() -> None:
+    cell = _preempt_cell()
+    if cell is not None:
+        cell.clear()
+        return
     _preempt.clear()
 
 
@@ -1292,7 +1359,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
         donation_probe = bool(use_async)   # probe until donation observed
         while True:
             while steps_done < n_steps:
-                if _preempt.is_set():
+                if preemption_requested():
                     preempted = True
                     break
                 # Self-healing actions execute at dispatch boundaries (a
@@ -1303,7 +1370,7 @@ def run_resilient(step_fn: Callable[[Dict], Dict], state: Dict, n_steps: int,
                 if chaos is not None:
                     state = chaos.apply(state, steps_done, _emit,
                                         span=steps_per_call)
-                    if _preempt.is_set():
+                    if preemption_requested():
                         preempted = True
                         break
                 state_tap = _CHAOS_STATE_TAP

@@ -15,8 +15,10 @@ reachable functionally through the returned/gettable :class:`GlobalGrid`.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -180,8 +182,49 @@ class GlobalGrid:
 
 _global_grid: Optional[GlobalGrid] = None
 # Monotonic epoch; bumped at every init/finalize so compiled-function caches
-# keyed on it cannot leak across grid lifetimes.
+# keyed on it cannot leak across grid lifetimes.  The counter allocates
+# epochs for EVERY handle (process-wide and thread-scoped alike), so two
+# grids that are live concurrently can never share a cache key.
 _grid_epoch: int = 0
+_epoch_lock = threading.Lock()
+
+# Thread-scoped grid handles (igg.serve): a worker thread inside
+# :func:`thread_grid_scope` sees ITS OWN grid through every ambient
+# accessor (`global_grid`/`set_global_grid`/`grid_epoch`), so concurrent
+# jobs on disjoint device subsets each own a full grid lifecycle without
+# clobbering the process singleton — or each other.  Threads outside a
+# scope keep the module-global handle, so single-job semantics are
+# byte-identical to before.
+_grid_tls = threading.local()
+
+
+def _next_epoch() -> int:
+    global _grid_epoch
+    with _epoch_lock:
+        _grid_epoch += 1
+        return _grid_epoch
+
+
+def _grid_scope() -> Optional[dict]:
+    return getattr(_grid_tls, "scope", None)
+
+
+@contextlib.contextmanager
+def thread_grid_scope():
+    """Make the ambient grid handle THREAD-LOCAL inside this context: the
+    calling thread starts with no grid, `init_global_grid` installs into
+    the scope, `finalize_global_grid` clears it, and no other thread can
+    see (or disturb) it.  The scheduler tier (:mod:`igg.serve`) wraps each
+    concurrent job's worker in one of these so jobs on disjoint device
+    subsets run full grid lifecycles side by side.  Scopes nest (the
+    previous scope is restored on exit); a grid still installed at exit is
+    discarded with the scope."""
+    prev = _grid_scope()
+    _grid_tls.scope = {"grid": None, "epoch": _next_epoch()}
+    try:
+        yield
+    finally:
+        _grid_tls.scope = prev
 
 
 class GridError(RuntimeError):
@@ -211,6 +254,9 @@ def replicating_jit(fn, out_sharding):
 
 
 def grid_is_initialized() -> bool:
+    sc = _grid_scope()
+    if sc is not None:
+        return sc["grid"] is not None
     return _global_grid is not None
 
 
@@ -224,6 +270,9 @@ def check_initialized() -> None:
 
 def global_grid() -> GlobalGrid:
     check_initialized()
+    sc = _grid_scope()
+    if sc is not None:
+        return sc["grid"]
     return _global_grid
 
 
@@ -235,13 +284,27 @@ def get_global_grid() -> GlobalGrid:
 
 
 def set_global_grid(gg: Optional[GlobalGrid]) -> None:
-    global _global_grid, _grid_epoch
+    global _global_grid
+    sc = _grid_scope()
+    epoch = _next_epoch()
+    if sc is not None:
+        sc["grid"] = gg
+        sc["epoch"] = epoch
+        return
     _global_grid = gg
-    _grid_epoch += 1
+    _GLOBAL_EPOCH[0] = epoch
+
+
+# Epoch of the PROCESS-WIDE handle: scoped setters allocate from the same
+# counter but must not move the epoch unscoped readers key their caches on.
+_GLOBAL_EPOCH = [0]
 
 
 def grid_epoch() -> int:
-    return _grid_epoch
+    sc = _grid_scope()
+    if sc is not None:
+        return sc["epoch"]
+    return _GLOBAL_EPOCH[0]
 
 
 # Convenience accessors mirroring the reference's syntax sugar

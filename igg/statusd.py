@@ -108,6 +108,7 @@ REASON_ALL_QUARANTINED = "all_members_quarantined"
 REASON_ESCALATED = "heal_escalated"
 REASON_FETCH_LAG = "watchdog_fetch_lag"
 REASON_INTEGRITY = "integrity_violation"
+REASON_QUEUE_SATURATED = "queue_saturated"
 
 _HEAL_KINDS = ("heal_planned", "heal_retile", "heal_repack",
                "heal_suppressed", "heal_skipped", "heal_escalated",
@@ -144,6 +145,11 @@ class HealthState:
                                                 1000))
         self._lock = threading.Lock()
         self._attached = False
+        # Serve-driven backpressure verdict (igg.serve): not bus-folded,
+        # so it survives the attach-time _reset — the scheduler sets it
+        # while the global queue is at bound and clears it on drain
+        # (readiness RECOVERS).
+        self.queue_saturated: Optional[dict] = None
         self._reset()
 
     def _reset(self) -> None:
@@ -190,6 +196,27 @@ class HealthState:
         if self._attached:
             self._attached = False
             _telemetry.unsubscribe(self._on_record)
+
+    def set_queue_saturated(self, info: Optional[dict] = None, *,
+                            depth: Optional[int] = None,
+                            bound: Optional[int] = None) -> None:
+        """Pin (or clear, with `info=None` and no kwargs) the
+        ``queue_saturated`` readiness reason: the serve scheduler calls
+        this when its global admission queue reaches its bound (503 —
+        shed traffic tells the balancer to back off) and again when the
+        drain brings it back below (readiness recovers)."""
+        if info is None and depth is None and bound is None:
+            with self._lock:
+                self.queue_saturated = None
+            return
+        doc = dict(info or {})
+        if depth is not None:
+            doc["depth"] = int(depth)
+        if bound is not None:
+            doc["bound"] = int(bound)
+        doc["wall"] = time.time()
+        with self._lock:
+            self.queue_saturated = doc
 
     # -- detection ---------------------------------------------------------
     def feed(self, record: dict) -> None:
@@ -329,6 +356,14 @@ class HealthState:
                     "rank": v.get("rank"),
                     "device": v.get("device"),
                     "step": v.get("step")})
+            if self.queue_saturated is not None:
+                # Admission backpressure (igg.serve): the global queue is
+                # at bound — new submissions shed until the drain brings
+                # it back under (the reason clears and readiness
+                # recovers).
+                reasons.append({"reason": REASON_QUEUE_SATURATED,
+                                "depth": self.queue_saturated.get("depth"),
+                                "bound": self.queue_saturated.get("bound")})
             if self.max_fetch_lag > 0:
                 for run, info in self.runs.items():
                     lag = info.get("fetch_lag_steps")
@@ -537,6 +572,48 @@ class _Handler(BaseHTTPRequestHandler):
             route = "(500)"
         _telemetry.counter("igg_statusd_requests_total", route=route).inc()
 
+    def do_POST(self):   # noqa: N802 - http.server API
+        """``POST /jobs``: online job submission (igg.serve).  The body
+        is the JSON job spec; the response is the admission verdict —
+        201 admitted, 200 idempotent duplicate, 400 rejected with the
+        reason, 409 name conflict / quarantined, 429 shed
+        (backpressure), 503 draining.  Absent a serving scheduler the
+        route answers 503."""
+        app = self.app
+        route = urlsplit(self.path).path.rstrip("/") or "/"
+        try:
+            if route != "/jobs":
+                self._send_json(404, {"error": f"unknown route {route!r}",
+                                      "routes": ["/jobs"]})
+                route = "(404)"
+            else:
+                submit = app._submit_cb
+                if submit is None:
+                    self._send_json(503, {
+                        "status": "rejected",
+                        "reason": "no serving scheduler attached"})
+                else:
+                    try:
+                        length = int(self.headers.get(
+                            "Content-Length") or 0)
+                    except ValueError:
+                        length = 0
+                    # Cap the read BEFORE buffering: an oversized body is
+                    # shed by the transport, not malloc'd first.
+                    cap = 1 << 20
+                    raw = self.rfile.read(min(max(length, 0), cap))
+                    res = submit(raw)
+                    self._send_json(res.code, res.doc())
+        except BrokenPipeError:
+            return
+        except Exception as e:
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                return
+            route = "(500)"
+        _telemetry.counter("igg_statusd_requests_total", route=route).inc()
+
 
 class StatusServer:
     """The live ops endpoint (module docstring).  On rank 0, `start()`
@@ -568,6 +645,11 @@ class StatusServer:
         self._stop = threading.Event()
         self._started_mono: Optional[float] = None
         self._fleet_journal: Optional[pathlib.Path] = None
+        # igg.serve wiring: the live scheduler's stats snapshot (the
+        # /status per-tenant section) and its admission entrypoint (the
+        # POST /jobs body → verdict).
+        self._serve_stats_cb = None
+        self._submit_cb = None
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -639,6 +721,14 @@ class StatusServer:
         """Point `/status`'s fleet summary at a live queue journal
         (:func:`igg.run_fleet` calls this with its ``journal.json``)."""
         self._fleet_journal = pathlib.Path(journal)
+
+    def watch_serve(self, stats_cb, submit_cb) -> None:
+        """Attach (or, with two Nones, detach) a live serve scheduler:
+        `stats_cb() -> dict` feeds the `/status` per-tenant section,
+        `submit_cb(raw) -> SubmissionResult` answers ``POST /jobs``
+        (:func:`igg.serve.serve_fleet` calls this)."""
+        self._serve_stats_cb = stats_cb
+        self._submit_cb = submit_cb
 
     def _telemetry_dir(self) -> Optional[pathlib.Path]:
         """Where rank snapshots live: the explicit ``dir=``, else the
@@ -765,6 +855,18 @@ class StatusServer:
         return {"journal": str(journal), "jobs": len(jobs),
                 "by_status": by_status}
 
+    def _serve_doc(self) -> Optional[dict]:
+        """The `/status` serve section: queue depth/bound/saturation plus
+        the per-tenant table (queued, running, done/failed/quarantined,
+        shed/rejected, retry budget) — None without a live scheduler."""
+        cb = self._serve_stats_cb
+        if cb is None:
+            return None
+        try:
+            return cb()
+        except Exception:
+            return None
+
     def status_doc(self) -> dict:
         """The `/status` body (module docstring)."""
         from . import degrade as _degrade
@@ -802,6 +904,7 @@ class StatusServer:
             "quarantine": {t: q.reason
                            for t, q in _degrade.status().items()},
             "fleet": self._fleet_summary(),
+            "serve": self._serve_doc(),
             "hbm": self.hbm.last,
             "gauges": gauges,
             "ranks": ranks,

@@ -205,6 +205,7 @@ def build_from_dir(directory, n: int = _DEFAULT_EVENTS
         "integrity": view.get("integrity"),
         "checkpoint": view["checkpoint"],
         "fleet": None,
+        "serve": None,
         "hbm": hbm,
         "gauges": ({"igg_exposed_comm_fraction": comm_fraction}
                    if comm_fraction is not None else {}),
@@ -345,6 +346,24 @@ def render(status: dict, events: List[dict],
         counts = ", ".join(f"{k}={v}" for k, v in
                            sorted((fleet.get("by_status") or {}).items()))
         lines.append(f"fleet: {fleet.get('jobs')} job(s) [{counts}]")
+    serve = status.get("serve")
+    if serve:
+        flags = ("" + (" SATURATED" if serve.get("saturated") else "")
+                 + (" DRAINING" if serve.get("draining") else ""))
+        fenced = serve.get("fenced_devices") or []
+        fence_s = (", fenced " + ",".join(str(i) for i in fenced)
+                   if fenced else "")
+        lines.append(f"serve: queue {serve.get('queue_depth')}/"
+                     f"{serve.get('queue_bound')}{flags}, running "
+                     f"{len(serve.get('running') or [])}{fence_s}")
+        for name, t in sorted((serve.get("tenants") or {}).items()):
+            lines.append(
+                f"  tenant {name:<12} q={t.get('queued')} "
+                f"run={t.get('running')} done={t.get('done')} "
+                f"quar={t.get('quarantined')} shed={t.get('shed')} "
+                f"rej={t.get('rejected')} budget "
+                f"{t.get('retries_used')}/{t.get('retry_budget')} "
+                f"w={t.get('weight')}")
     heal = status.get("heal") or []
     if heal:
         last = heal[-1]

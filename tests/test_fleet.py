@@ -222,3 +222,46 @@ def test_run_fleet_rejects_live_grid(tmp_path):
     igg.finalize_global_grid()
     with pytest.raises(igg.GridError, match="duplicate"):
         igg.run_fleet([_job("a"), _job("a")], tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# Journal identity: the config hash guards resumed-name matches
+# ---------------------------------------------------------------------------
+
+def test_resume_reused_name_different_config_is_fresh(tmp_path):
+    """A resumed journal matches a job by more than its name: a reused
+    name with a DIFFERENT config (here: more steps) is a fresh job — the
+    stale record and ring are dropped with a `job_name_reused` warning,
+    never silently skipped as done or resumed from the other config's
+    ring."""
+    igg.run_fleet([_job("a", n_steps=10)], tmp_path)
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["jobs"]["a"]["config_hash"]
+
+    events = []
+    res = igg.run_fleet([_job("a", n_steps=20)], tmp_path, resume=True,
+                        on_event=events.append)
+    assert res.jobs["a"].status == "done"
+    assert res.jobs["a"].result is not None          # genuinely re-run
+    assert res.jobs["a"].result.steps_done == 20
+    reused = [e for e in events if e.kind == "job_name_reused"]
+    assert len(reused) == 1
+    assert reused[0].detail["prior_status"] == "done"
+    assert reused[0].detail["prior_config_hash"] != \
+        reused[0].detail["config_hash"]
+    j = json.loads((tmp_path / "journal.json").read_text())
+    assert j["jobs"]["a"]["steps_done"] == 20
+    assert j["jobs"]["a"]["config_hash"] == reused[0].detail["config_hash"]
+
+
+def test_resume_same_config_still_skips(tmp_path):
+    """The other direction: an identical config under the same name keeps
+    the journal-identity contract of PR 13 — resume skips it as done, no
+    reset, no warning."""
+    igg.run_fleet([_job("a")], tmp_path)
+    events = []
+    res = igg.run_fleet([_job("a")], tmp_path, resume=True,
+                        on_event=events.append)
+    assert res.jobs["a"].status == "done"
+    assert res.jobs["a"].result is None              # skipped, not re-run
+    assert not any(e.kind == "job_name_reused" for e in events)

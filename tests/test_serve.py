@@ -1,0 +1,543 @@
+"""The always-on fleet service (igg/serve.py) on the 8-device CPU mesh:
+admission control with structured verdicts, backpressure and shedding,
+concurrent jobs on disjoint device subsets behind thread-scoped grid
+lifecycles, weighted-fair multi-tenant scheduling with poison-job
+quarantine, priority preemption, device fencing, the graceful drain
+protocol with `resume=True` reconciliation, and the hostile-intake chaos
+injectors (`arrival_storm`, `malformed_submission`)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import igg
+from igg import serve as iserve
+from igg import shared as ishared
+from igg.resilience import (PreemptionCell, preemption_scope,
+                            preemption_requested, request_preemption,
+                            clear_preemption)
+from helpers import ensemble_member_step
+
+
+def _make_states(seed, members):
+    """Decomposition-invariant member states (the test_fleet idiom) so
+    bit-exactness comparisons survive elastic re-planning."""
+    def build(grid):
+        rng = np.random.default_rng(seed)
+        g = [grid.dims[d] * (grid.nxyz[d] - grid.overlaps[d])
+             for d in range(3)]
+        out = []
+        for _ in range(members):
+            glob = rng.standard_normal(g)
+
+            def block(coords, ls, glob=glob):
+                idx = [(coords[d] * (ls[d] - grid.overlaps[d])
+                        + np.arange(ls[d])) % g[d] for d in range(3)]
+                return glob[np.ix_(*idx)]
+
+            T = igg.from_local_blocks(block, tuple(grid.nxyz))
+            out.append({"T": igg.update_halo(T)})
+        return out
+    return build
+
+
+def _factory(spec):
+    members = spec.get("members", 1)
+    job = igg.Job(name=spec["name"], global_interior=(8, 8, 8),
+                  members=members, n_steps=spec["n_steps"],
+                  make_states=_make_states(spec.get("seed", 1), members),
+                  step_fn=ensemble_member_step(), watch_every=5,
+                  checkpoint_every=spec.get("checkpoint_every", 5))
+    if spec.get("doom"):
+        job.ring = 0          # invalid config -> terminal GridError
+    return job
+
+
+def _spec(name, n_steps=8, **kw):
+    out = {"name": name, "global_interior": [8, 8, 8], "members": 2,
+           "n_steps": n_steps}
+    out.update(kw)
+    return out
+
+
+def _wait(pred, timeout=90, poll=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+class _Serve:
+    """serve_fleet on a background thread, driven via ServeControl."""
+
+    def __init__(self, workdir, factory=_factory, **kw):
+        self.ctl = igg.ServeControl()
+        self.events = []
+        self.error = None
+        self.result = None
+        kw.setdefault("stop_when_idle_s", 0.8)
+        kw.setdefault("poll_s", 0.02)
+        kw.setdefault("install_sigterm", False)
+        kw.setdefault("backoff", 0.01)
+
+        def run():
+            try:
+                self.result = igg.serve_fleet(
+                    workdir, factory, control=self.ctl,
+                    on_event=self.events.append, **kw)
+            except BaseException as e:       # surfaced on __exit__
+                self.error = e
+
+        self.thread = threading.Thread(target=run)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.ctl.wait_ready(30)
+        return self
+
+    def __exit__(self, *exc):
+        self.thread.join(timeout=240)
+        assert not self.thread.is_alive(), "serve loop did not exit"
+        if self.error is not None and not exc[0]:
+            raise self.error
+
+    def kinds(self, kind):
+        return [e for e in list(self.events) if e.kind == kind]
+
+
+def _final_interior(ring_dir):
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    out = igg.load_checkpoint(igg.latest_checkpoint(ring_dir, "ens"),
+                              redistribute=True)
+    got = np.asarray(igg.gather_interior(out["T"]))
+    igg.finalize_global_grid()
+    return got
+
+
+# ---------------------------------------------------------------------------
+# Thread-scoped grid + preemption (the substrate of concurrent jobs)
+# ---------------------------------------------------------------------------
+
+def test_thread_grid_scope_isolates_from_process_global():
+    assert not igg.grid_is_initialized()
+    seen = {}
+
+    def body():
+        with ishared.thread_grid_scope():
+            assert not igg.grid_is_initialized()
+            igg.init_global_grid(6, 6, 6, quiet=True)
+            seen["inside"] = igg.grid_is_initialized()
+            seen["epoch"] = ishared.grid_epoch()
+            igg.finalize_global_grid()
+        seen["after"] = igg.grid_is_initialized()
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join(timeout=60)
+    assert seen == {"inside": True, "epoch": seen["epoch"], "after": False}
+    # The scoped epoch came from the shared counter: a scoped grid can
+    # never collide with the process-global grid's compiled-cache keys.
+    assert seen["epoch"] != ishared.grid_epoch()
+    assert not igg.grid_is_initialized()
+
+
+def test_preemption_cell_scoped_to_thread():
+    cell = PreemptionCell()
+    hits = {}
+
+    def body():
+        with preemption_scope(cell):
+            request_preemption()           # lands in the CELL
+            hits["scoped"] = preemption_requested()
+            clear_preemption()             # clears only the cell
+            hits["cleared"] = preemption_requested()
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join(timeout=30)
+    assert hits == {"scoped": True, "cleared": False}
+    assert not preemption_requested()      # global flag never touched
+    # An external request on the cell reaches the scoped thread only.
+    cell.request()
+    assert cell.requested() and not preemption_requested()
+
+
+# ---------------------------------------------------------------------------
+# Admission control: the verdict table
+# ---------------------------------------------------------------------------
+
+def test_admission_verdicts(tmp_path):
+    events = []
+    cfg = dict(max_concurrent=2, queue_bound=3, tenant_queue_bound=2,
+               tenant_retry_budget=4, poll_s=0.01, max_body=200)
+    st = iserve._ServeState(tmp_path, _factory, __import__("jax").devices(),
+                            cfg, None, events.append, None)
+
+    # Malformed / oversized / structurally invalid: 400 at the door.
+    assert (st.submit(b"{not json").code, ) == (400, )
+    assert "malformed" in st.submit(b"{not json").reason
+    assert "oversized" in st.submit(b"x" * 500).reason
+    assert "name" in st.submit({"name": "bad name!", "n_steps": 1}).reason
+    assert "n_steps" in st.submit(
+        {"name": "a", "global_interior": [8, 8, 8]}).reason
+    assert "oversized" in st.submit(
+        {"name": "a", "global_interior": [8, 8, 10 ** 7],
+         "n_steps": 1}).reason
+    # Inadmissible decomposition: plan_dims feasibility at the door.
+    inf = st.submit({"name": "inf", "global_interior": [2, 2, 2],
+                     "overlaps": [4, 4, 4], "n_steps": 1})
+    assert inf.code == 400 and inf.reason.startswith("infeasible")
+
+    # Admission + idempotency on (tenant, name, submit_token).
+    ok = st.submit(_spec("j1", submit_token="t1"))
+    assert (ok.code, ok.status) == (201, "admitted")
+    dup = st.submit(_spec("j1", submit_token="t1"))
+    assert (dup.code, dup.status) == (200, "duplicate")
+    clash = st.submit(_spec("j1", submit_token="OTHER"))
+    assert (clash.code, clash.reason) == (409, "name_in_use")
+
+    # Journal record carries the multi-tenant identity fields.
+    rec = st.journal["jobs"]["j1"]
+    assert rec["tenant"] == "default" and rec["status"] == "queued"
+    assert rec["config_hash"] and rec["submit_token"] == "t1"
+    assert rec["submitted_at"] > 0 and isinstance(rec["spec"], dict)
+
+    # A quarantined name never re-admits; a done name is a duplicate.
+    for name, status, code, why in (("qq", "quarantined", 409,
+                                     "quarantined"),
+                                    ("dd", "done", 200, "already done")):
+        spec, _ = st._validate(_spec(name, tenant="term"))
+        st.journal["jobs"][name] = {"status": status,
+                                    "config_hash": st._spec_hash(spec)}
+        got = st.submit(_spec(name, tenant="term"))
+        assert (got.code, got.reason) == (code, why)
+
+    # Same name, DIFFERENT config hash: fresh job + job_name_reused.
+    reused = st.submit(_spec("dd", tenant="term", n_steps=99))
+    assert (reused.code, reused.status) == (201, "admitted")
+    ev = [e for e in events if e.kind == "job_name_reused"]
+    assert len(ev) == 1 and ev[0].detail["prior_status"] == "done"
+
+    # Per-tenant bound, then the global bound: 429 with distinct reasons.
+    assert st.submit(_spec("j2")).code == 201       # global depth now 3
+    full = st.submit(_spec("j3"))
+    assert (full.code, full.reason) == (429, "tenant_queue_full")
+    sat = st.submit(_spec("j4", tenant="third"))
+    assert (sat.code, sat.reason) == (429, "queue_saturated")
+
+    # Tenant retry budget exhausted: its submissions shed.
+    st._tenant("greedy")["retries_used"] = 99
+    broke = st.submit(_spec("j6", tenant="greedy"))
+    assert (broke.code, broke.reason) == (429, "tenant_budget_exhausted")
+
+    # Draining: intake answers 503.
+    st.draining = True
+    drain = st.submit(_spec("late"))
+    assert (drain.code, drain.reason) == (503, "draining")
+
+    # The shed/rejected ledgers reconcile with per-tenant accounting.
+    assert sum(t["shed"] for t in st.tenants.values()) == len(st.shed)
+    assert len([e for e in events if e.kind == "job_shed"]) == len(st.shed)
+
+
+def test_serve_rejects_live_grid(tmp_path):
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    with pytest.raises(igg.GridError, match="finalize"):
+        igg.serve_fleet(tmp_path, _factory, stop_when_idle_s=0.1)
+    igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# Concurrent jobs on disjoint subsets + bit-exactness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_concurrent_disjoint_subsets_bit_exact(tmp_path):
+    """Two tenants' jobs run CONCURRENTLY on disjoint 4-device subsets
+    (observed via the live stats snapshot) and each finishes bit-identical
+    to the same job run alone through igg.run_fleet."""
+    with _Serve(tmp_path / "serve", max_concurrent=2) as s:
+        a = s.ctl.submit(_spec("a", tenant="alice", seed=1, n_steps=40))
+        b = s.ctl.submit(_spec("b", tenant="bob", seed=2, n_steps=40))
+        assert a.code == 201 and b.code == 201
+        assert _wait(lambda: len(s.ctl.stats()["running"]) == 2), \
+            "jobs never overlapped"
+    r = s.result
+    assert r.jobs["a"].status == "done" and r.jobs["b"].status == "done"
+    assert not igg.grid_is_initialized()
+
+    # Serial oracle on the full mesh.
+    def _job(name, seed):
+        return igg.Job(name=name, global_interior=(8, 8, 8), members=2,
+                       n_steps=40, make_states=_make_states(seed, 2),
+                       step_fn=ensemble_member_step(), watch_every=5,
+                       checkpoint_every=5)
+    igg.run_fleet([_job("a", 1), _job("b", 2)], tmp_path / "serial")
+    for name in ("a", "b"):
+        np.testing.assert_array_equal(
+            _final_interior(tmp_path / "serve" / "jobs" / name),
+            _final_interior(tmp_path / "serial" / "jobs" / name))
+
+
+# ---------------------------------------------------------------------------
+# Priority preemption / deadlines / fencing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_priority_preempts_running_job(tmp_path):
+    """A hot submission that cannot be placed preempts the lowest-priority
+    running job through ITS cell: the victim seals a generation, re-admits,
+    and BOTH finish done."""
+    with _Serve(tmp_path, max_concurrent=2) as s:
+        low = s.ctl.submit(_spec("low", n_devices=8, n_steps=4000,
+                                 checkpoint_every=500))
+        assert low.code == 201
+        assert _wait(lambda: "low" in s.ctl.stats()["running"])
+        hot = s.ctl.submit(_spec("hot", priority=5, n_steps=8))
+        assert hot.code == 201
+        assert _wait(lambda: any(e.kind == "job_requeued"
+                                 and e.detail["reason"] == "priority"
+                                 for e in list(s.events)))
+    r = s.result
+    assert r.jobs["hot"].status == "done"
+    assert r.jobs["low"].status == "done"
+    assert any(e.kind == "job_resumed" for e in s.events)
+
+
+def test_deadline_expired_submission_sheds(tmp_path):
+    with _Serve(tmp_path, max_concurrent=1) as s:
+        s.ctl.submit(_spec("big", n_devices=8, n_steps=2000,
+                           checkpoint_every=500))
+        assert _wait(lambda: "big" in s.ctl.stats()["running"])
+        s.ctl.submit(_spec("urgent", deadline_s=0.1, n_steps=4))
+        assert _wait(lambda: any(
+            e.kind == "job_shed"
+            and e.detail["reason"] == "deadline_exceeded"
+            for e in list(s.events)))
+    r = s.result
+    assert "urgent" not in r.jobs
+    shed = [x for x in r.shed if x["job"] == "urgent"]
+    assert shed and shed[0]["reason"] == "deadline_exceeded"
+    # A deadline-shed submission leaves no journal residue.
+    j = json.loads(r.journal.read_text())
+    assert "urgent" not in j["jobs"]
+
+
+@pytest.mark.slow
+def test_fence_device_shrinks_only_its_jobs(tmp_path):
+    """Fencing one device preempts exactly the jobs whose subset holds it
+    (here: the first-launched job on devices[0:4]); the disjoint job is
+    untouched and the victim resumes elastically on a shrunk pool."""
+    with _Serve(tmp_path, max_concurrent=2) as s:
+        s.ctl.submit(_spec("a", tenant="alice", n_steps=4000,
+                           checkpoint_every=500))
+        assert _wait(lambda: "a" in s.ctl.stats()["running"])
+        s.ctl.submit(_spec("b", tenant="bob", seed=2, n_steps=4000,
+                           checkpoint_every=500))
+        assert _wait(lambda: len(s.ctl.stats()["running"]) == 2)
+        s.ctl.fence_device(0)
+        assert _wait(lambda: s.kinds("device_fenced"))
+        assert _wait(lambda: any(e.detail["reason"] == "fence"
+                                 for e in s.kinds("job_requeued")))
+    r = s.result
+    fence = s.kinds("device_fenced")[0]
+    assert fence.detail["device"] == 0 and fence.detail["jobs"] == ["a"]
+    # Only the victim was requeued; the disjoint job ran straight through.
+    assert {e.detail["job"] for e in s.kinds("job_requeued")} == {"a"}
+    assert r.jobs["a"].status == "done" and r.jobs["b"].status == "done"
+    # The elastic resume re-planned onto fewer devices than the original
+    # half-mesh share.
+    assert int(np.prod(r.jobs["a"].dims)) < 4
+
+
+# ---------------------------------------------------------------------------
+# Drain + resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_drain_seals_and_resume_is_bit_exact(tmp_path):
+    """ServeControl.drain (the SIGTERM path): intake stops with 503, the
+    running job seals a generation and stays journaled `preempted`, the
+    journal seals — and a resume=True relaunch finishes it bit-identical
+    to an uninterrupted run."""
+    wd = tmp_path / "serve"
+    with _Serve(wd, stop_when_idle_s=None) as s:
+        s.ctl.submit(_spec("a", n_steps=4000, checkpoint_every=500,
+                           n_devices=8))
+        assert _wait(lambda: "a" in s.ctl.stats()["running"])
+        # Queued behind "a" (which holds all 8 devices): the drain must
+        # leave it journaled `queued`, NOT launch it onto the devices
+        # the sealing worker releases.
+        s.ctl.submit(_spec("q", n_steps=4))
+        s.ctl.drain()
+        late = s.ctl.submit(_spec("late"))
+        assert (late.code, late.reason) == (503, "draining")
+    r = s.result
+    assert r.drained and r.jobs["a"].status == "preempted"
+    assert "q" not in r.jobs
+    j = json.loads(r.journal.read_text())
+    assert j["jobs"]["a"]["status"] == "preempted"
+    assert j["jobs"]["q"]["status"] == "queued"
+    assert j["sealed_at"] > 0
+
+    with _Serve(wd, resume=True) as s2:
+        pass
+    r2 = s2.result
+    assert r2.jobs["a"].status == "done"
+    assert r2.jobs["q"].status == "done"
+    assert any(e.detail.get("source") == "resume"
+               for e in s2.kinds("job_admitted"))
+
+    igg.run_fleet([igg.Job(name="a", global_interior=(8, 8, 8), members=2,
+                           n_steps=4000, make_states=_make_states(1, 2),
+                           step_fn=ensemble_member_step(), watch_every=5,
+                           checkpoint_every=500)], tmp_path / "clean")
+    np.testing.assert_array_equal(
+        _final_interior(wd / "jobs" / "a"),
+        _final_interior(tmp_path / "clean" / "jobs" / "a"))
+
+
+# ---------------------------------------------------------------------------
+# Quarantine + tenant isolation
+# ---------------------------------------------------------------------------
+
+def test_poison_job_quarantined_and_never_readmitted(tmp_path):
+    with _Serve(tmp_path) as s:
+        assert s.ctl.submit(_spec("poison", doom=True, n_steps=4,
+                                  submit_token="t")).code == 201
+        assert _wait(lambda: s.kinds("job_quarantined"))
+        again = s.ctl.submit(_spec("poison", doom=True, n_steps=4,
+                                   submit_token="t2"))
+        assert (again.code, again.reason) == (409, "quarantined")
+    r = s.result
+    assert r.jobs["poison"].status == "quarantined"
+    j = json.loads(r.journal.read_text())
+    assert j["jobs"]["poison"]["status"] == "quarantined"
+    assert r.tenants["default"]["quarantined"] == 1
+
+    # resume=True leaves the quarantined record terminal.
+    with _Serve(tmp_path, resume=True) as s2:
+        pass
+    assert "poison" not in s2.result.jobs
+    assert json.loads(s2.result.journal.read_text())[
+        "jobs"]["poison"]["status"] == "quarantined"
+
+
+@pytest.mark.slow
+def test_two_tenant_isolation_hostile_vs_healthy(tmp_path):
+    """Satellite: a hostile tenant (poison jobs + a submission flood)
+    burns ITS budget and floods ITS queue; the healthy tenant's jobs all
+    finish bit-identical to an unloaded run, and every refusal is
+    accounted — shed/rejected ledgers, per-tenant counters and the
+    journal reconcile exactly."""
+    with _Serve(tmp_path / "serve", max_concurrent=2,
+                tenant_queue_bound=2, tenant_retry_budget=2) as s:
+        assert s.ctl.submit(_spec("h1", tenant="healthy", seed=1,
+                                  n_steps=20)).code == 201
+        assert s.ctl.submit(_spec("m1", tenant="mallory", doom=True,
+                                  n_steps=4)).code == 201
+        assert s.ctl.submit(_spec("h2", tenant="healthy", seed=2,
+                                  n_steps=20)).code == 201
+        # Flood: the tenant bound (2) sheds the excess without touching
+        # the healthy queue.
+        codes = [s.ctl.submit(_spec(f"m{i}", tenant="mallory",
+                                    doom=True, n_steps=4)).code
+                 for i in range(2, 8)]
+        assert 429 in codes
+        # After the first quarantine burns the 2-strike budget, mallory
+        # sheds at the DOOR with tenant_budget_exhausted.
+        assert _wait(lambda: s.kinds("job_quarantined"))
+        broke = s.ctl.submit(_spec("m99", tenant="mallory", doom=True,
+                                   n_steps=4))
+        assert (broke.code, broke.reason) == (429,
+                                              "tenant_budget_exhausted")
+    r = s.result
+    assert r.jobs["h1"].status == "done"
+    assert r.jobs["h2"].status == "done"
+    mal = r.tenants["mallory"]
+    assert mal["quarantined"] >= 1 and mal["shed"] >= 2
+    assert mal["retries_used"] >= mal["retry_budget"]
+    assert r.tenants["healthy"]["shed"] == 0
+    assert r.tenants["healthy"]["rejected"] == 0
+
+    # Accounting reconciliation: ledgers == per-tenant counters == events.
+    assert sum(t["shed"] for t in r.tenants.values()) == len(r.shed)
+    assert sum(t["rejected"] for t in r.tenants.values()) == len(
+        r.rejected)
+    assert len(s.kinds("job_shed")) == len(r.shed)
+    j = json.loads(r.journal.read_text())
+    assert j["jobs"]["h1"]["status"] == "done"
+    assert all(rec["status"] in ("done", "quarantined", "queued")
+               for rec in j["jobs"].values())
+
+    # Healthy tenant bit-exactness under hostile load.
+    def _job(name, seed):
+        return igg.Job(name=name, global_interior=(8, 8, 8), members=2,
+                       n_steps=20, make_states=_make_states(seed, 2),
+                       step_fn=ensemble_member_step(), watch_every=5,
+                       checkpoint_every=5)
+    igg.run_fleet([_job("h1", 1), _job("h2", 2)], tmp_path / "clean")
+    for name, seed in (("h1", 1), ("h2", 2)):
+        np.testing.assert_array_equal(
+            _final_interior(tmp_path / "serve" / "jobs" / name),
+            _final_interior(tmp_path / "clean" / "jobs" / name))
+
+
+# ---------------------------------------------------------------------------
+# Hostile-intake chaos injectors
+# ---------------------------------------------------------------------------
+
+def test_arrival_storm_and_malformed_chaos(tmp_path):
+    """arrival_storm floods the intake in one tick — the queue fills to
+    its bound, the overflow sheds; malformed_submission is rejected at
+    the door.  Both compose under igg.chaos.armed()."""
+    storm = igg.chaos.arrival_storm(10, tenant="load",
+                                    spec={"global_interior": [8, 8, 8],
+                                          "members": 1, "n_steps": 2})
+    with igg.chaos.armed(storm, igg.chaos.malformed_submission(times=2)):
+        assert iserve._CHAOS_SUBMIT_TAP is not None
+        with _Serve(tmp_path, max_concurrent=2, queue_bound=3,
+                    tenant_queue_bound=8) as s:
+            assert _wait(lambda: s.kinds("job_shed"))
+    assert iserve._CHAOS_SUBMIT_TAP is None        # consumed one-shot
+    r = s.result
+    admitted = [e for e in s.kinds("job_admitted")
+                if e.detail.get("source") == "storm"]
+    assert len(admitted) + len(s.kinds("job_shed")) == 10
+    assert r.tenants["load"]["shed"] == len(s.kinds("job_shed")) >= 1
+    assert all(x["reason"] in ("queue_saturated", "tenant_queue_full")
+               for x in r.shed)
+    # Every admitted storm job actually ran to completion.
+    assert all(r.jobs[e.detail["job"]].status == "done"
+               for e in admitted)
+    # The malformed bodies were rejected with the parse reason.
+    mal = [x for x in r.rejected if x["source"] == "chaos"]
+    assert len(mal) == 2 and all("malformed" in x["reason"] for x in mal)
+
+
+# ---------------------------------------------------------------------------
+# Spool intake
+# ---------------------------------------------------------------------------
+
+def test_spool_intake_and_rejected_quarantine_dir(tmp_path):
+    import os
+
+    with _Serve(tmp_path) as s:
+        spool = tmp_path / "spool"
+        assert _wait(lambda: spool.is_dir())
+        tmp = spool / ".tmp-good"
+        tmp.write_text(json.dumps(_spec("spooled", n_steps=4)))
+        os.rename(tmp, spool / "good.json")       # atomic-rename protocol
+        (spool / "bad.json").write_bytes(b"{nope")
+        assert _wait(lambda: s.kinds("job_rejected"))
+    r = s.result
+    assert r.jobs["spooled"].status == "done"
+    # The malformed body is preserved for post-mortem, not lost.
+    assert (tmp_path / "spool" / "rejected" / "bad.json").exists()
+    assert not list((tmp_path / "spool").glob("*.json"))

@@ -708,3 +708,86 @@ def test_statusd_env_knobs_registered():
                  "IGG_STATUSD_HBM_EVERY", "IGG_STATUSD_MAX_FETCH_LAG",
                  "IGG_STATUSD_PUBLISH_EVERY"):
         assert knob in _env._KNOWN
+
+
+# ---------------------------------------------------------------------------
+# (xi) the serve plane: queue_saturated readiness, POST /jobs, /status
+# ---------------------------------------------------------------------------
+
+def test_readiness_queue_saturated_pinned_and_recovers():
+    """Admission backpressure is a pinned readiness reason: readiness
+    flips 503/'queue_saturated' (with depth/bound) while the serve queue
+    is at bound and RECOVERS when the drain clears it."""
+    assert statusd.REASON_QUEUE_SATURATED == "queue_saturated"
+    with statusd.StatusServer(port=0) as srv:
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"]
+        srv.health.set_queue_saturated(depth=16, bound=16)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 503 and h["live"] and not h["ready"]
+        (reason,) = h["reasons"]
+        assert reason["reason"] == "queue_saturated"
+        assert reason["depth"] == 16 and reason["bound"] == 16
+        srv.health.set_queue_saturated(None)
+        code, h = _get(srv.url + "/healthz")
+        assert code == 200 and h["ready"] and h["reasons"] == []
+
+
+def test_post_jobs_route_verdicts_and_status_tenants():
+    """``POST /jobs`` answers the scheduler's admission verdict verbatim
+    (201/200/400/429 + JSON body), 404 off-route, 503 with no serving
+    scheduler attached; /status gains the per-tenant `serve` section and
+    igg.top renders it."""
+    from igg.serve import SubmissionResult
+    from igg import top as itop2
+
+    def _post(url, data):
+        req = urllib.request.Request(url, data=data, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    verdicts = {
+        b'{"name": "ok"}': SubmissionResult(201, "admitted", job="ok",
+                                            tenant="t"),
+        b'{"name": "dup"}': SubmissionResult(200, "duplicate",
+                                             reason="already enqueued"),
+        b"{broken": SubmissionResult(400, "rejected",
+                                     reason="malformed: bad"),
+        b'{"name": "full"}': SubmissionResult(429, "shed",
+                                              reason="queue_saturated"),
+    }
+    stats = {"queue_depth": 2, "queue_bound": 16, "saturated": False,
+             "running": ["ok"], "fenced_devices": [3],
+             "draining": False,
+             "tenants": {"alice": {"queued": 1, "running": 1, "done": 4,
+                                   "failed": 0, "quarantined": 0,
+                                   "shed": 2, "rejected": 1,
+                                   "retries_used": 3, "retry_budget": 8,
+                                   "weight": 2.0}}}
+    with statusd.StatusServer(port=0) as srv:
+        # No scheduler attached: the route answers 503, not 404.
+        code, body = _post(srv.url + "/jobs", b"{}")
+        assert code == 503 and "no serving scheduler" in body["reason"]
+        srv.watch_serve(lambda: stats, lambda raw: verdicts[bytes(raw)])
+        for raw, want in verdicts.items():
+            code, body = _post(srv.url + "/jobs", raw)
+            assert code == want.code and body == want.doc()
+        code, body = _post(srv.url + "/elsewhere", b"{}")
+        assert code == 404 and "/jobs" in body["routes"]
+        # /status: the serve section IS the scheduler's stats doc.
+        _, s = _get(srv.url + "/status")
+        assert s["serve"] == stats
+        # igg.top renders the tenant table from the same doc.
+        frame = itop2.render(s, [], 0)
+        assert "serve: queue 2/16" in frame and "fenced 3" in frame
+        assert "tenant alice" in frame and "shed=2" in frame
+        assert "budget 3/8" in frame
+        # Detach: the section disappears and POST answers 503 again.
+        srv.watch_serve(None, None)
+        _, s = _get(srv.url + "/status")
+        assert s["serve"] is None
+        code, _ = _post(srv.url + "/jobs", b"{}")
+        assert code == 503
